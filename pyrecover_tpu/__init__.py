@@ -1,0 +1,21 @@
+"""pyrecover_tpu — a TPU-native resilient pre-training framework.
+
+A brand-new JAX/XLA/Pallas implementation of the capabilities of the
+PyRecover reference (distributed checkpointing + job-resilience harness for
+LLM pre-training): data-parallel (and tensor/sequence-parallel) training of a
+Llama-style decoder-only Transformer, dual-strategy checkpointing (host-0
+single-file with checksum verification, and sharded multi-host async
+checkpoints), `latest`-checkpoint discovery with retention pruning, bit-exact
+resume (model, optimizer, LR schedule, RNG, and data-order state), time-aware
+checkpointing that watches the job deadline / preemption notices, a Pallas
+flash-attention kernel, and throughput/MFU observability.
+
+Unlike the reference's `pyrecover/__init__.py:5-7` (which advertises
+`setup_resubmission` / `monitor_timelimit` from modules that do not exist and
+therefore breaks every import), this package only exports what is actually
+implemented.
+"""
+
+from pyrecover_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,3 @@
+from pyrecover_tpu.models.llama import ModelConfig, forward, init_params
+
+__all__ = ["ModelConfig", "init_params", "forward"]

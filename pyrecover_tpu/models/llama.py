@@ -1,0 +1,224 @@
+"""Llama-3-style decoder-only Transformer as pure functions over a pytree.
+
+Capability parity with the reference `model.py` (Transformer :330-395,
+TransformerBlock :272-327, Attention :142-230, FeedForward :233-269,
+RMSNorm :25-49), re-designed TPU-first:
+
+  * Pure ``init_params`` / ``forward`` functions — no module objects, no
+    mutable state. The parameter pytree IS the checkpointable object, which
+    makes bit-exact resume structural instead of effortful.
+  * Layers are *stacked* along a leading axis and iterated with
+    ``jax.lax.scan`` — one compiled layer body regardless of depth (fast
+    compiles, friendly to pipeline-style sharding later).
+  * Optional rematerialization (``jax.checkpoint``) of each block — the HBM
+    bandwidth lever the reference has no equivalent of.
+  * Activation sharding constraints via ``parallel.mesh.constrain`` — under
+    a mesh, activations carry (data, sequence, tensor) shardings; on one
+    device the constraints vanish.
+  * Params stored in ``param_dtype`` (fp32 master by default), compute in
+    ``compute_dtype`` (bf16 default — the MXU's native format). The
+    reference instead builds the whole model in bf16 (`train.py:100-101`).
+"""
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from pyrecover_tpu.ops.attention import sdpa_attention
+from pyrecover_tpu.ops.rope import apply_rope, precompute_rope
+from pyrecover_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_TENSOR, constrain
+from pyrecover_tpu.utils.dtypes import resolve_dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Shape parity with reference ``TransformerModelArgs`` (model.py:9-22).
+
+    Defaults mirror the reference's 8B default config (train.py:88-99):
+    dim 4096, 32 layers, GQA 32q/8kv, ffn multiplier 1.3, multiple_of 1024,
+    rope theta 5e5 — vocab/seq come from tokenizer/flags at call sites.
+    """
+
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    vocab_size: int = 131072
+    ffn_dim_multiplier: float = 1.3
+    multiple_of: int = 1024
+    norm_eps: float = 1e-5
+    rope_theta: float = 500000.0
+    max_seq_len: int = 2048
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"
+    attention_impl: str = "sdpa"  # "sdpa" | "flash" | "ring"
+    remat: bool = False
+    flash_block_q: int = 512
+    flash_block_kv: int = 512
+
+    @property
+    def head_dim(self):
+        return self.dim // self.n_heads
+
+    @property
+    def ffn_hidden_dim(self):
+        """SwiGLU hidden size: round-up-to-multiple_of of
+        ffn_dim_multiplier * (2/3 * 4 * dim) (reference model.py:258-262)."""
+        hidden = int(2 * (4 * self.dim) / 3)
+        hidden = int(self.ffn_dim_multiplier * hidden)
+        return self.multiple_of * (
+            (hidden + self.multiple_of - 1) // self.multiple_of
+        )
+
+    def tiny(self, **overrides):
+        """A small test-sized variant of this config."""
+        base = dict(
+            dim=64, n_layers=2, n_heads=4, n_kv_heads=2, vocab_size=256,
+            multiple_of=32, max_seq_len=64,
+        )
+        base.update(overrides)
+        return dataclasses.replace(self, **base)
+
+
+def _normal_init(key, shape, std, dtype):
+    return (jax.random.normal(key, shape, dtype=jnp.float32) * std).astype(dtype)
+
+
+def init_params(rng, config):
+    """Initialize the parameter pytree.
+
+    GPT-2-style scaled init: std 0.02 everywhere, with the residual-output
+    projections (wo, w2) scaled by 1/sqrt(2*n_layers). (The reference leans
+    on torch's nn.Linear defaults — init parity is not a capability, training
+    stability is.)
+    """
+    cfg = config
+    pdt = resolve_dtype(cfg.param_dtype)
+    hd = cfg.head_dim
+    ffn = cfg.ffn_hidden_dim
+    L = cfg.n_layers
+    std = 0.02
+    resid_std = std / (2 * L) ** 0.5
+
+    keys = jax.random.split(rng, 10)
+
+    def stacked(key, shape, s):
+        # one independent draw per layer, stacked on axis 0
+        ks = jax.random.split(key, L)
+        return jnp.stack([_normal_init(k, shape, s, pdt) for k in ks])
+
+    params = {
+        "tok_embed": _normal_init(keys[0], (cfg.vocab_size, cfg.dim), std, pdt),
+        "layers": {
+            "attn_norm": jnp.ones((L, cfg.dim), dtype=pdt),
+            "wq": stacked(keys[1], (cfg.dim, cfg.n_heads * hd), std),
+            "wk": stacked(keys[2], (cfg.dim, cfg.n_kv_heads * hd), std),
+            "wv": stacked(keys[3], (cfg.dim, cfg.n_kv_heads * hd), std),
+            "wo": stacked(keys[4], (cfg.n_heads * hd, cfg.dim), resid_std),
+            "ffn_norm": jnp.ones((L, cfg.dim), dtype=pdt),
+            "w1": stacked(keys[5], (cfg.dim, ffn), std),
+            "w3": stacked(keys[6], (cfg.dim, ffn), std),
+            "w2": stacked(keys[7], (ffn, cfg.dim), resid_std),
+        },
+        "final_norm": jnp.ones((cfg.dim,), dtype=pdt),
+        "output": _normal_init(keys[8], (cfg.dim, cfg.vocab_size), std, pdt),
+    }
+    return params
+
+
+def rms_norm(x, scale, eps):
+    """RMSNorm, fp32 internally then cast back (reference model.py:25-49)."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def _attention_fn(config):
+    if config.attention_impl == "flash":
+        from pyrecover_tpu.ops.flash_attention import flash_attention
+
+        return partial(
+            flash_attention,
+            block_q=config.flash_block_q,
+            block_kv=config.flash_block_kv,
+        )
+    if config.attention_impl == "ring":
+        from pyrecover_tpu.ops.ring_attention import ring_attention
+
+        return ring_attention
+    return sdpa_attention
+
+
+def _block(x, layer, cos, sin, config, attn_fn):
+    """One pre-norm transformer block (reference model.py:272-327)."""
+    cfg = config
+    cdt = resolve_dtype(cfg.compute_dtype)
+    b, s, d = x.shape
+    hd = cfg.head_dim
+
+    # --- attention sublayer ---
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = h @ layer["wq"].astype(cdt)
+    k = h @ layer["wk"].astype(cdt)
+    v = h @ layer["wv"].astype(cdt)
+    q = q.reshape(b, s, cfg.n_heads, hd)
+    k = k.reshape(b, s, cfg.n_kv_heads, hd)
+    v = v.reshape(b, s, cfg.n_kv_heads, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    q = constrain(q, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, AXIS_TENSOR, None)
+    k = constrain(k, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, AXIS_TENSOR, None)
+    v = constrain(v, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, AXIS_TENSOR, None)
+    attn = attn_fn(q, k, v, causal=True)
+    attn = attn.reshape(b, s, cfg.n_heads * hd)
+    x = x + attn @ layer["wo"].astype(cdt)
+    x = constrain(x, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, None)
+
+    # --- SwiGLU FFN sublayer (reference model.py:268-269) ---
+    h = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(h @ layer["w1"].astype(cdt))
+    up = h @ layer["w3"].astype(cdt)
+    x = x + (gate * up) @ layer["w2"].astype(cdt)
+    x = constrain(x, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, None)
+    return x
+
+
+def forward(params, tokens, config):
+    """Forward pass: tokens (batch, seq) int32 → logits (batch, seq, vocab) fp32.
+
+    Mirrors reference `Transformer.forward` (model.py:376-395): embed →
+    n_layers pre-norm blocks → final RMSNorm → untied vocab projection.
+    Logits are returned in fp32 (the reference casts in its loss,
+    train.py:263-266).
+    """
+    cfg = config
+    cdt = resolve_dtype(cfg.compute_dtype)
+    seq_len = tokens.shape[1]
+
+    cos, sin = precompute_rope(cfg.head_dim, seq_len, cfg.rope_theta)
+    attn_fn = _attention_fn(cfg)
+
+    x = params["tok_embed"].astype(cdt)[tokens]
+    x = constrain(x, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, None)
+
+    block = partial(_block, cos=cos, sin=sin, config=cfg, attn_fn=attn_fn)
+    if cfg.remat:
+        block = jax.checkpoint(
+            block, policy=jax.checkpoint_policies.nothing_saveable
+        )
+
+    def scan_body(x, layer):
+        return block(x, layer), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, params["output"].astype(cdt),
+        preferred_element_type=jnp.float32,
+    )
+    logits = constrain(logits, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, AXIS_TENSOR)
+    return logits

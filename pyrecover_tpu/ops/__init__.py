@@ -1,0 +1,4 @@
+from pyrecover_tpu.ops.attention import sdpa_attention
+from pyrecover_tpu.ops.rope import apply_rope, precompute_rope
+
+__all__ = ["sdpa_attention", "apply_rope", "precompute_rope"]

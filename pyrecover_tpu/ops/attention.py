@@ -1,0 +1,63 @@
+"""Causal grouped-query attention — XLA reference path.
+
+The default attention of the reference is
+`F.scaled_dot_product_attention(is_causal=True)` after an explicit
+`repeat_kv` materialization (`model.py:130-139, 192, 219-220`). Here GQA is
+expressed without materializing repeated KV heads: queries are reshaped to
+(kv_heads, group) and contracted against the original KV, which XLA fuses
+into MXU matmuls with no memory blow-up.
+
+Softmax and score accumulation are fp32 regardless of input dtype
+(``preferred_element_type``) — required both for stability and for the
+bit-exact resume guarantee (fixed reduction order under jit).
+
+The Pallas flash-attention kernel (`pyrecover_tpu.ops.flash_attention`) is
+the `--use_flash_attention` equivalent; this module is the always-available
+fallback and the numerical ground truth it is tested against.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def sdpa_attention(q, k, v, *, causal=True, scale=None):
+    """Scaled dot-product attention with GQA.
+
+    Args:
+      q: (batch, q_len, n_heads, head_dim)
+      k: (batch, kv_len, n_kv_heads, head_dim)
+      v: (batch, kv_len, n_kv_heads, head_dim)
+      causal: apply a causal mask (queries attend to keys at <= position,
+        aligned at the end — standard for q_len == kv_len training).
+      scale: optional softmax scale; defaults to 1/sqrt(head_dim).
+
+    Returns:
+      (batch, q_len, n_heads, head_dim) in q.dtype.
+    """
+    b, sq, hq, d = q.shape
+    _, sk, hkv, _ = k.shape
+    if hq % hkv != 0:
+        raise ValueError(f"n_heads={hq} not divisible by n_kv_heads={hkv}")
+    group = hq // hkv
+    if scale is None:
+        scale = 1.0 / (d**0.5)
+
+    qg = q.reshape(b, sq, hkv, group, d)
+    # scores: (b, hkv, group, sq, sk), accumulated fp32 on the MXU
+    scores = jnp.einsum(
+        "bqkgd,bskd->bkgqs", qg, k, preferred_element_type=jnp.float32
+    )
+    scores = scores * jnp.float32(scale)
+
+    if causal:
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0) + (sk - sq)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        mask = qpos >= kpos
+        scores = jnp.where(mask, scores, jnp.float32(-1e30))
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bkgqs,bskd->bqkgd", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, sq, hq, d).astype(q.dtype)

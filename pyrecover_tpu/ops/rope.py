@@ -1,0 +1,45 @@
+"""Rotary position embeddings (RoPE).
+
+Same math as the reference's complex-number formulation
+(`model.py:52-127`: `precompute_freqs_cis` / `apply_rotary_emb`), expressed
+with real cos/sin tables — the TPU-friendly form (no complex dtypes, which
+XLA on TPU lowers poorly). The reference pairs *adjacent* elements
+(`view_as_complex` of a `(..., d/2, 2)` reshape); we keep that interleaved
+convention so head-dim semantics match.
+
+The table is a function of (head_dim, max_seq_len, theta) only — it is
+recomputed at trace time and never stored in checkpoints, matching the
+reference's *non-persistent* `freqs_cis` buffer (`model.py:357-359`).
+"""
+
+import jax.numpy as jnp
+
+
+def precompute_rope(head_dim, max_seq_len, theta=500000.0, dtype=jnp.float32):
+    """Returns (cos, sin), each of shape (max_seq_len, head_dim // 2)."""
+    if head_dim % 2 != 0:
+        raise ValueError(f"head_dim must be even, got {head_dim}")
+    freqs = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    angles = jnp.outer(jnp.arange(max_seq_len, dtype=jnp.float32), freqs)
+    return jnp.cos(angles).astype(dtype), jnp.sin(angles).astype(dtype)
+
+
+def apply_rope(x, cos, sin):
+    """Rotate q or k. ``x``: (..., seq, heads, head_dim); cos/sin: (seq, head_dim//2).
+
+    Interleaved-pair convention: elements (2i, 2i+1) form the complex pair,
+    matching reference `model.py:101-127`. Computed in fp32, cast back.
+    """
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    x1 = xf[..., 0::2]
+    x2 = xf[..., 1::2]
+    # broadcast cos/sin over batch and heads: (seq, 1, hd/2)
+    c = cos[:, None, :]
+    s = sin[:, None, :]
+    r1 = x1 * c - x2 * s
+    r2 = x2 * c + x1 * s
+    out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+    return out.astype(orig_dtype)

@@ -1,0 +1,15 @@
+from pyrecover_tpu.parallel.mesh import MeshConfig, create_mesh, constrain
+from pyrecover_tpu.parallel.sharding import (
+    batch_pspec,
+    param_pspecs,
+    shard_params,
+)
+
+__all__ = [
+    "MeshConfig",
+    "create_mesh",
+    "constrain",
+    "batch_pspec",
+    "param_pspecs",
+    "shard_params",
+]

@@ -1,0 +1,154 @@
+"""Device-mesh construction and activation sharding constraints.
+
+This is the TPU-native replacement for the reference's NCCL/DDP runtime
+(`dist_utils.py:38-68`: SLURM env discovery → `init_process_group("nccl")` →
+`torch.cuda.set_device`). On TPU there is no rendezvous code to write: the
+slice topology comes from the TPU runtime via `jax.distributed.initialize()`,
+and all communication is XLA collectives over ICI/DCN inserted by the
+compiler from sharding annotations.
+
+Mesh axes:
+  * ``data``     — data parallelism (batch dimension). DDP's gradient
+                   allreduce (reference `train.py:268-269`) becomes an XLA
+                   AllReduce over this axis, inserted automatically by jit.
+  * ``fsdp``     — parameter/optimizer sharding (ZeRO-3 style). The reference
+                   has no FSDP (SURVEY §2.2) — this axis is the TPU-idiomatic
+                   way to fit models that don't fit replicated.
+  * ``tensor``   — tensor (Megatron-style) parallelism over heads / FFN
+                   hidden, collectives ride ICI.
+  * ``sequence`` — sequence/context parallelism for long sequences (ring
+                   attention over this axis).
+"""
+
+import dataclasses
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_TENSOR = "tensor"
+AXIS_SEQ = "sequence"
+
+MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_TENSOR, AXIS_SEQ)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical mesh shape. ``data=-1`` means "all remaining devices"."""
+
+    data: int = -1
+    fsdp: int = 1
+    tensor: int = 1
+    sequence: int = 1
+
+    def resolve(self, n_devices):
+        fixed = self.fsdp * self.tensor * self.sequence
+        data = self.data
+        if data == -1:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fsdp*tensor*sequence={fixed}"
+                )
+            data = n_devices // fixed
+        total = data * fixed
+        if total != n_devices:
+            raise ValueError(
+                f"Mesh {data}x{self.fsdp}x{self.tensor}x{self.sequence}={total} "
+                f"!= available devices {n_devices}"
+            )
+        return (data, self.fsdp, self.tensor, self.sequence)
+
+
+def create_mesh(config=None, devices=None):
+    """Build a 4-axis ``jax.sharding.Mesh`` over the available devices."""
+    if config is None:
+        config = MeshConfig()
+    if devices is None:
+        devices = jax.devices()
+    shape = config.resolve(len(devices))
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def mesh_axis_size(mesh, axis):
+    return mesh.shape.get(axis, 1)
+
+
+def _filter_spec_for_mesh(spec, axis_names):
+    """Drop mesh axes that don't exist (size-1 axes are fine; missing names
+    would error), so model code can annotate with the full logical spec and
+    degrade gracefully on smaller meshes."""
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in axis_names)
+            out.append(kept if kept else None)
+        else:
+            out.append(entry if entry in axis_names else None)
+    return P(*out)
+
+
+def constrain(x, *spec):
+    """``with_sharding_constraint`` that is a no-op outside a mesh context.
+
+    Model code calls ``constrain(x, 'data', None, 'tensor')`` unconditionally;
+    under ``jax.sharding.set_mesh`` (or an in-scope concrete mesh) the
+    constraint is applied, otherwise the value passes through untouched so
+    the same model runs single-device.
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return x
+    filtered = _filter_spec_for_mesh(spec, set(mesh.axis_names))
+    return jax.lax.with_sharding_constraint(x, filtered)
+
+
+def initialize_distributed(coordinator_address=None, num_processes=None, process_id=None):
+    """Multi-host init: the TPU-native `maybe_init_distributed`
+    (reference `dist_utils.py:38-68`).
+
+    On Cloud TPU pods all arguments are discovered from the TPU metadata/
+    runtime, so a bare ``jax.distributed.initialize()`` suffices; explicit
+    args are accepted for non-TPU clusters (the SLURM-env analogue).
+    No-op when running single-process.
+    """
+    if jax.process_count() > 1:
+        return  # already initialized
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs = dict(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    try:
+        jax.distributed.initialize(**kwargs)
+    except (ValueError, RuntimeError):
+        # Single-process run (no cluster env) — mirrors the reference's
+        # maybe_* behavior of silently running non-distributed.
+        pass
+
+
+def sync_global_devices(tag="barrier"):
+    """Cross-host barrier (reference `dist.barrier()` call sites, e.g.
+    checkpoint.py:56,103). No-op single-process."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+
+def broadcast_host0_scalar(value):
+    """Host-0 decides, everyone follows — the stop-flag broadcast pattern
+    (reference `train.py:342-346`). Returns the host-0 value on all hosts."""
+    if jax.process_count() <= 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    arr = np.asarray(value)
+    return multihost_utils.broadcast_one_to_all(arr).item()

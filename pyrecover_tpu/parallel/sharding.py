@@ -1,0 +1,85 @@
+"""Parameter and batch partition rules.
+
+The reference's only parallelism is DDP — params replicated, batch sharded
+(`train.py:107-115`, SURVEY §2.2). Here the same intent is expressed as
+PartitionSpecs over the 4-axis mesh, which also unlocks tensor parallelism
+(Megatron-style column/row sharding of attention + FFN) and fsdp (ZeRO-3)
+with zero changes to the model code: XLA inserts the collectives.
+
+Rules are path-based over the parameter pytree produced by
+``pyrecover_tpu.models.llama.init_params``.
+"""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pyrecover_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_TENSOR
+
+# name of final pytree leaf key -> spec factory, keyed on leaf ndim
+_RULES = {
+    # embeddings: shard vocab on tensor, model dim on fsdp
+    "tok_embed": P(AXIS_TENSOR, AXIS_FSDP),
+    # attention projections, stacked over layers at dim 0:
+    #   wq/wk/wv (L, D, heads*hd): column parallel — output dim on tensor
+    "wq": P(None, AXIS_FSDP, AXIS_TENSOR),
+    "wk": P(None, AXIS_FSDP, AXIS_TENSOR),
+    "wv": P(None, AXIS_FSDP, AXIS_TENSOR),
+    #   wo (L, heads*hd, D): row parallel — input dim on tensor
+    "wo": P(None, AXIS_TENSOR, AXIS_FSDP),
+    # SwiGLU FFN (reference model.py:233-269 semantics):
+    "w1": P(None, AXIS_FSDP, AXIS_TENSOR),
+    "w3": P(None, AXIS_FSDP, AXIS_TENSOR),
+    "w2": P(None, AXIS_TENSOR, AXIS_FSDP),
+    # norms: replicated (tiny)
+    "attn_norm": P(None, None),
+    "ffn_norm": P(None, None),
+    "final_norm": P(None),
+    # untied output projection (D, V) (reference model.py:367)
+    "output": P(AXIS_FSDP, AXIS_TENSOR),
+}
+
+
+def _leaf_rule(path):
+    for part in reversed(path):
+        key = str(getattr(part, "key", getattr(part, "name", "")))
+        if key in _RULES:
+            return _RULES[key]
+    return None
+
+
+def param_pspecs(params):
+    """PartitionSpec pytree matching ``params``' structure."""
+
+    def spec_for(path, leaf):
+        rule = _leaf_rule(path)
+        if rule is None:
+            return P(*([None] * leaf.ndim))
+        if len(rule) != leaf.ndim:
+            raise ValueError(
+                f"Partition rule {rule} rank-mismatches leaf {path} with shape {leaf.shape}"
+            )
+        return rule
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def batch_pspec():
+    """Token batches: (batch, seq) sharded over (data+fsdp, sequence).
+
+    fsdp participates in batch sharding — ZeRO shards both data and params —
+    matching the standard TPU recipe (scaling-book: dp×fsdp both consume the
+    batch axis).
+    """
+    return P((AXIS_DATA, AXIS_FSDP), AXIS_SEQ)
+
+
+def shard_params(params, mesh):
+    """Place a parameter pytree onto ``mesh`` per the partition rules."""
+    specs = param_pspecs(params)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, P())

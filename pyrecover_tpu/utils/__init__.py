@@ -1,0 +1,18 @@
+from pyrecover_tpu.utils.dtypes import PRECISION_STR_TO_DTYPE, resolve_dtype
+from pyrecover_tpu.utils.logging import get_logger, init_logger, log_host0
+from pyrecover_tpu.utils.perf import (
+    get_num_flop_per_token,
+    get_num_params,
+    tpu_peak_flops,
+)
+
+__all__ = [
+    "PRECISION_STR_TO_DTYPE",
+    "resolve_dtype",
+    "get_logger",
+    "init_logger",
+    "log_host0",
+    "get_num_params",
+    "get_num_flop_per_token",
+    "tpu_peak_flops",
+]

@@ -1,0 +1,33 @@
+"""Dtype policy.
+
+Parity: reference `utils.py:11-16` maps precision strings to torch dtypes and
+`utils.py:92-102` sets a global default dtype during model construction. In
+JAX there is no mutable global dtype — the policy is threaded explicitly:
+``param_dtype`` for the stored parameter pytree and ``compute_dtype`` for
+activations/matmuls (cast at use, accumulate in fp32 on the MXU via
+``preferred_element_type``).
+"""
+
+import jax.numpy as jnp
+
+PRECISION_STR_TO_DTYPE = {
+    "fp16": jnp.float16,
+    "bf16": jnp.bfloat16,
+    "fp32": jnp.float32,
+    "fp64": jnp.float64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+}
+
+
+def resolve_dtype(name):
+    if not isinstance(name, str):
+        return jnp.dtype(name)
+    try:
+        return PRECISION_STR_TO_DTYPE[name.lower()]
+    except KeyError:
+        raise ValueError(
+            f"Unknown precision {name!r}; expected one of {sorted(PRECISION_STR_TO_DTYPE)}"
+        ) from None

@@ -1,0 +1,49 @@
+"""Host-0-gated structured logging.
+
+Parity: reference `utils.py:19-27` (timestamped root logger) and
+`dist_utils.py:84-90` (`log_rank0`). On TPU pods the analogue of "rank" is
+the JAX *process index* (one process per host), so gating is by
+``jax.process_index() == 0``.
+"""
+
+import logging
+import sys
+
+_LOGGER_NAME = "pyrecover_tpu"
+
+
+def init_logger(level=logging.INFO):
+    logger = logging.getLogger(_LOGGER_NAME)
+    if logger.handlers:
+        return logger
+    handler = logging.StreamHandler(sys.stdout)
+    handler.setFormatter(
+        logging.Formatter(
+            fmt="%(asctime)s [%(levelname)s] %(name)s: %(message)s",
+            datefmt="%Y-%m-%d %H:%M:%S",
+        )
+    )
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    logger.propagate = False
+    return logger
+
+
+def get_logger():
+    return init_logger()
+
+
+def _process_index():
+    # Deferred import so logging works before jax.distributed is initialized.
+    import jax
+
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_host0(msg, *args, level=logging.INFO):
+    """Log only on host 0 (reference `dist_utils.py:89-90` log_rank0)."""
+    if _process_index() == 0:
+        get_logger().log(level, msg, *args)

@@ -1,0 +1,65 @@
+"""Performance accounting: parameter counts, analytic FLOPs, TPU peak FLOPs.
+
+Parity: reference `utils.py:30-56` (``get_num_params``,
+``get_num_flop_per_token`` = 6N + 12·layers·heads·head_dim·seq_len) and the
+hard-coded H100 peak of 989e12 FLOP/s at `train.py:287`, replaced here by a
+per-generation TPU peak table so MFU is meaningful on the hardware actually
+in use.
+"""
+
+import jax
+import jax.numpy as jnp
+
+# Dense bf16 peak FLOP/s per chip, per TPU generation. Sources: public Cloud
+# TPU system architecture docs (v4: 275 TFLOP/s bf16; v5e: 197; v5p: 459;
+# v6e/Trillium: 918).
+TPU_PEAK_FLOPS_BF16 = {
+    "v3": 123e12,
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5litepod": 197e12,
+    "v5 lite": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+_CPU_FALLBACK_PEAK = 1e12  # arbitrary stand-in so MFU math never divides by 0
+
+
+def tpu_peak_flops(device=None):
+    """Best-effort peak bf16 FLOP/s for the local accelerator."""
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, peak in TPU_PEAK_FLOPS_BF16.items():
+        if key in kind:
+            return peak
+    return _CPU_FALLBACK_PEAK
+
+
+def get_num_params(params, exclude_embedding=False):
+    """Total parameter count of a pytree (reference `utils.py:30-38`).
+
+    ``exclude_embedding`` drops leaves whose path contains ``embed`` —
+    matching the reference's exclusion of the token embedding for FLOPs
+    accounting.
+    """
+    leaves = jax.tree_util.tree_leaves_with_path(params)
+    total = 0
+    for path, leaf in leaves:
+        if exclude_embedding and any(
+            "embed" in str(getattr(p, "key", getattr(p, "name", ""))).lower()
+            for p in path
+        ):
+            continue
+        total += int(jnp.size(leaf))
+    return total
+
+
+def get_num_flop_per_token(num_params, n_layers, n_heads, head_dim, seq_len):
+    """Analytic FLOPs/token: 6N + 12·l·h·q·t (reference `utils.py:41-56`).
+
+    6N covers fwd+bwd matmul FLOPs on non-embedding params; the second term
+    is the attention score/value FLOPs which scale with sequence length.
+    """
+    return 6 * num_params + 12 * n_layers * n_heads * head_dim * seq_len

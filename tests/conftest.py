@@ -1,0 +1,42 @@
+"""Test environment bootstrap.
+
+The test suite runs on CPU with 8 virtual XLA devices — the fake-cluster
+mechanism (SURVEY §4: `--xla_force_host_platform_device_count`) that lets
+multi-device sharding, collectives, and distributed-checkpoint tests run on
+any host, deterministically, with no TPU attached.
+
+The container's sitecustomize may register a TPU backend at interpreter
+start (before conftest runs). XLA flags are latched when the first backend
+client is created — which hasn't happened yet when conftest imports — so we
+set the environment here, force the platform to cpu, and drop any
+already-resolved backends.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+import jax.extend.backend as _jeb  # noqa: E402
+
+_jeb.clear_backends()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def tmp_ckpt_dir(tmp_path):
+    d = tmp_path / "checkpoints"
+    d.mkdir()
+    return d
