@@ -1,0 +1,24 @@
+from pyrecover_tpu.checkpoint.registry import (
+    checkpoint_path,
+    get_latest_checkpoint,
+    list_checkpoints,
+    prune_checkpoints,
+)
+from pyrecover_tpu.checkpoint.vanilla import load_ckpt_vanilla, save_ckpt_vanilla
+from pyrecover_tpu.checkpoint.sharded import (
+    ShardedCheckpointer,
+    load_ckpt_sharded,
+    save_ckpt_sharded,
+)
+
+__all__ = [
+    "checkpoint_path",
+    "get_latest_checkpoint",
+    "list_checkpoints",
+    "prune_checkpoints",
+    "save_ckpt_vanilla",
+    "load_ckpt_vanilla",
+    "ShardedCheckpointer",
+    "save_ckpt_sharded",
+    "load_ckpt_sharded",
+]

@@ -1,0 +1,174 @@
+"""Vanilla checkpointing: host-0 single-file save with checksum verification.
+
+Capability parity with reference `save_ckpt_vanilla` / `load_ckpt_vanilla`
+(checkpoint.py:25-215): one file holds the FULL training state, a checksum
+sidecar guards integrity (verification overlaps the load in a background
+thread, the reference's trick at checkpoint.py:151-178), retention pruning
+keeps the newest N, and `latest` is discoverable. TPU-native differences:
+
+  * The payload is the whole functional state pytree (params, optimizer
+    state, step/epoch, RNG key data) + the sampler's data-order state — so a
+    resume is bit-exact by construction. The reference loses sampler state
+    silently (SURVEY §2.3 defect 3) and never saves RNG.
+  * Serialization is flat msgpack of the pytree leaves (numpy), written
+    atomically (tmp file + rename) so a preemption mid-write can never
+    corrupt `latest` — the reference writes in place.
+  * Multi-host: non-addressable (sharded) leaves are allgathered to host 0;
+    on load every host reads the file and `device_put`s onto its target
+    shardings. SHA-256 replaces MD5.
+"""
+
+import hashlib
+import json
+import os
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+import jax
+import numpy as np
+from flax.serialization import msgpack_restore, msgpack_serialize
+
+from pyrecover_tpu.checkpoint.registry import prune_checkpoints
+from pyrecover_tpu.parallel.mesh import sync_global_devices
+from pyrecover_tpu.utils.logging import log_host0
+
+FORMAT_VERSION = 1
+
+
+def _leaf_to_numpy(leaf):
+    if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(leaf, tiled=True))
+    return np.asarray(leaf)
+
+
+def compute_checksum(path, chunk_size=16 * 1024 * 1024):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(chunk_size)
+            if not chunk:
+                break
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _sidecar(path):
+    p = Path(path)
+    return p.with_suffix(p.suffix + ".sha256")
+
+
+def save_ckpt_vanilla(path, state, sampler_state=None, *, verify=False,
+                      max_keep=None, extra_meta=None):
+    """Write the full training state to a single file (host 0 only).
+
+    Returns wall seconds spent (host 0; other hosts return barrier time) —
+    the save-timing signal the reference logs (train.py:332-340).
+    """
+    t0 = time.monotonic()
+    path = Path(path)
+    sync_global_devices("vanilla_save_enter")
+
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    np_leaves = [_leaf_to_numpy(x) for x in leaves]  # allgather runs on ALL hosts
+
+    if jax.process_index() == 0:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        meta = {
+            "format": FORMAT_VERSION,
+            "num_leaves": len(np_leaves),
+            "treedef": str(treedef),
+            "sampler": sampler_state or {},
+        }
+        if extra_meta:
+            meta.update(extra_meta)
+        payload = msgpack_serialize(
+            {
+                "meta": json.dumps(meta),
+                "leaves": {str(i): leaf for i, leaf in enumerate(np_leaves)},
+            }
+        )
+        fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=path.name, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(payload)
+            os.replace(tmp, path)  # atomic publish
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        if verify:
+            _sidecar(path).write_text(compute_checksum(path))
+        if max_keep:
+            prune_checkpoints(path.parent, max_keep, sharded=False)
+
+    sync_global_devices("vanilla_save_exit")
+    return time.monotonic() - t0
+
+
+def load_ckpt_vanilla(path, target_state, *, verify=False):
+    """Restore a checkpoint into the structure/shardings of ``target_state``.
+
+    Every host reads the file; each leaf is ``device_put`` onto the
+    corresponding target leaf's sharding (resharding onto any topology —
+    SURVEY hard-part #2's load half). Checksum verification runs in a
+    background thread overlapping deserialization (reference
+    checkpoint.py:151-178). Returns (state, sampler_state, meta).
+    """
+    path = Path(path)
+    sync_global_devices("vanilla_load_enter")
+
+    verify_error = []
+    verify_thread = None
+    if verify:
+        sidecar = _sidecar(path)
+
+        def _verify():
+            if not sidecar.exists():
+                verify_error.append(f"checksum sidecar missing: {sidecar}")
+                return
+            expected = sidecar.read_text().strip()
+            actual = compute_checksum(path)
+            if actual != expected:
+                verify_error.append(
+                    f"checksum mismatch for {path}: expected {expected}, got {actual}"
+                )
+
+        verify_thread = threading.Thread(target=_verify, daemon=True)
+        verify_thread.start()
+
+    raw = msgpack_restore(path.read_bytes())
+    meta = json.loads(raw["meta"])
+    if meta["format"] != FORMAT_VERSION:
+        raise ValueError(f"Unsupported checkpoint format {meta['format']}")
+
+    leaves, treedef = jax.tree_util.tree_flatten(target_state)
+    if meta["num_leaves"] != len(leaves):
+        raise ValueError(
+            f"Checkpoint has {meta['num_leaves']} leaves, target expects {len(leaves)}"
+        )
+    np_leaves = [raw["leaves"][str(i)] for i in range(len(leaves))]
+
+    restored = []
+    for tgt, src in zip(leaves, np_leaves):
+        if tuple(tgt.shape) != tuple(src.shape):
+            raise ValueError(
+                f"Shape mismatch on restore: checkpoint {src.shape} vs target {tgt.shape}"
+            )
+        src = src.astype(tgt.dtype)
+        if isinstance(tgt, jax.Array) and hasattr(tgt, "sharding"):
+            restored.append(jax.device_put(src, tgt.sharding))
+        else:
+            restored.append(jax.numpy.asarray(src))
+    state = jax.tree_util.tree_unflatten(treedef, restored)
+
+    if verify_thread is not None:
+        verify_thread.join()
+        if verify_error:
+            raise ValueError(verify_error[0])
+        log_host0("Checkpoint checksum verified: %s", path)
+
+    sync_global_devices("vanilla_load_exit")
+    return state, meta.get("sampler", {}), meta

@@ -1,0 +1,216 @@
+"""Configuration: one dataclass surface + CLI parser.
+
+Flag parity with the reference's single argparse surface (`utils.py:105-261`,
+25 flags) — same flag strings wherever the concept survives the TPU
+re-design, plus TPU-native extensions (mesh shape, fsdp/tensor/sequence
+axes, remat, synthetic data). Torch-specific flags are kept as accepted
+aliases so reference launch lines keep working:
+
+  * ``--use-torch-distributed-ckpt`` → alias of ``--sharded-checkpoint``
+    (Orbax-style sharded save, the `torch.distributed.checkpoint` analogue).
+  * ``--fused-optimizer`` / ``--compile`` → accepted no-ops (XLA always
+    compiles and fuses the optimizer into the step).
+  * ``--use_flash_attention`` → selects the Pallas flash-attention kernel.
+  * ``--distributed`` → accepted; the mesh is sized from visible devices.
+"""
+
+import argparse
+import dataclasses
+from typing import Optional
+
+from pyrecover_tpu.models.llama import ModelConfig
+from pyrecover_tpu.parallel.mesh import MeshConfig
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    # -- data ----------------------------------------------------------------
+    dataset: str = ""  # path to parquet with a 'text' column; "" → synthetic
+    tokenizer_name_or_path: str = "unsloth/Mistral-Nemo-Base-2407-bnb-4bit"
+    sequence_length: int = 2048
+    batch_size: int = 1  # GLOBAL batch size (reference train.py:62-63 semantics)
+    training_samples: int = 0  # 0 → len(dataset); else wraparound like ref dataset.py:25
+    # -- optimization --------------------------------------------------------
+    learning_rate: float = 1e-5
+    lr_warmup_steps: int = 10
+    weight_decay: float = 0.1
+    adam_b1: float = 0.9
+    adam_b2: float = 0.95
+    grad_max_norm: float = 1.0
+    grad_clipping: bool = True  # the reference defines but disables clipping (train.py:272)
+    training_steps: int = 1000
+    seed: int = 42
+    # -- model ---------------------------------------------------------------
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    model_dtype: str = "bf16"  # compute dtype (reference --model-dtype)
+    param_dtype: str = "fp32"  # master weights; TPU-native improvement over all-bf16
+    use_flash_attention: bool = False
+    remat: bool = False
+    # -- parallelism ---------------------------------------------------------
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    distributed: bool = False  # accepted for parity; mesh is always used
+    # -- checkpointing -------------------------------------------------------
+    checkpoint_dir: str = "checkpoints/"
+    checkpoint_frequency: int = 10  # -1 disables (reference utils.py semantics)
+    resume_from_checkpoint: Optional[str] = None  # path | "latest"
+    experiment_name: str = "default-exp"
+    verify_checkpoints: bool = False
+    max_kept_checkpoints: int = 3
+    sharded_checkpoint: bool = False  # --use-torch-distributed-ckpt equivalent
+    async_checkpoint: bool = True  # overlap sharded saves with training
+    # -- time-aware checkpointing / preemption -------------------------------
+    timeaware_checkpointing: bool = False
+    default_iter_time: float = 1.0
+    default_ckpt_time: float = 10.0
+    job_end_time: Optional[float] = None  # unix seconds; else $JOB_END_TIME / SLURM_JOB_END_TIME
+    # -- observability -------------------------------------------------------
+    logging_frequency: int = 5
+    log_loss_to_csv: bool = False
+    profile: bool = False
+    profile_step_start: int = 10
+    profile_step_end: int = 12
+    profile_dir: str = "profiles/"
+
+    def __post_init__(self):
+        self.model = dataclasses.replace(
+            self.model,
+            max_seq_len=self.sequence_length,
+            compute_dtype={"bf16": "bfloat16", "fp16": "float16", "fp32": "float32",
+                           "fp64": "float64"}.get(self.model_dtype, self.model_dtype),
+            param_dtype={"bf16": "bfloat16", "fp16": "float16", "fp32": "float32",
+                         "fp64": "float64"}.get(self.param_dtype, self.param_dtype),
+            attention_impl="flash" if self.use_flash_attention else self.model.attention_impl,
+            remat=self.remat or self.model.remat,
+        )
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        description="pyrecover_tpu trainer",
+        fromfile_prefix_chars="@",
+    )
+    d = TrainConfig()
+
+    # data (reference utils.py:107-118)
+    p.add_argument("--dataset", type=str, default=d.dataset,
+                   help="Parquet file with a 'text' column. Empty → deterministic synthetic data.")
+    p.add_argument("--tokenizer-name-or-path", type=str, default=d.tokenizer_name_or_path)
+    p.add_argument("--sequence-length", type=int, default=d.sequence_length)
+    p.add_argument("--batch-size", type=int, default=d.batch_size,
+                   help="GLOBAL batch size, sharded over the data axis.")
+    p.add_argument("--training-samples", type=int, default=d.training_samples)
+
+    # optimization (utils.py:133-151, 171-175)
+    p.add_argument("--learning-rate", type=float, default=d.learning_rate)
+    p.add_argument("--lr-warmup-steps", type=int, default=d.lr_warmup_steps)
+    p.add_argument("--weight-decay", type=float, default=d.weight_decay)
+    p.add_argument("--grad-max-norm", type=float, default=d.grad_max_norm)
+    p.add_argument("--no-grad-clipping", action="store_true",
+                   help="Disable gradient clipping (the reference's accidental default, train.py:272).")
+    p.add_argument("--training-steps", type=int, default=d.training_steps)
+    p.add_argument("--seed", type=int, default=d.seed)
+    p.add_argument("--fused-optimizer", action="store_true",
+                   help="Accepted for parity; XLA always fuses the optimizer update.")
+    p.add_argument("--compile", action="store_true",
+                   help="Accepted for parity; the train step is always jit-compiled.")
+
+    # model (utils.py:176-181; model shape flags are new — the reference hard-codes 8B)
+    p.add_argument("--model-dtype", type=str, default=d.model_dtype)
+    p.add_argument("--param-dtype", type=str, default=d.param_dtype)
+    p.add_argument("--model-dim", type=int, default=d.model.dim)
+    p.add_argument("--model-layers", type=int, default=d.model.n_layers)
+    p.add_argument("--model-heads", type=int, default=d.model.n_heads)
+    p.add_argument("--model-kv-heads", type=int, default=d.model.n_kv_heads)
+    p.add_argument("--vocab-size", type=int, default=d.model.vocab_size,
+                   help="Used with synthetic data; with a tokenizer, its vocab size wins.")
+    p.add_argument("--use_flash_attention", "--use-flash-attention",
+                   dest="use_flash_attention", action="store_true")
+    p.add_argument("--remat", action="store_true",
+                   help="Rematerialize transformer blocks (trade FLOPs for HBM).")
+
+    # parallelism (new; the reference's --distributed has no shape control)
+    p.add_argument("--distributed", action="store_true")
+    p.add_argument("--dp", type=int, default=d.mesh.data, help="data-parallel axis size; -1 = all remaining")
+    p.add_argument("--fsdp", type=int, default=d.mesh.fsdp)
+    p.add_argument("--tp", type=int, default=d.mesh.tensor)
+    p.add_argument("--sp", type=int, default=d.mesh.sequence)
+
+    # checkpointing (utils.py:190-232)
+    p.add_argument("--checkpoint-dir", type=str, default=d.checkpoint_dir)
+    p.add_argument("--checkpoint-frequency", type=int, default=d.checkpoint_frequency)
+    p.add_argument("--resume-from-checkpoint", type=str, default=None)
+    p.add_argument("--experiment_name", "--experiment-name", dest="experiment_name",
+                   type=str, default=d.experiment_name)
+    p.add_argument("--verify-checkpoints", action="store_true")
+    p.add_argument("--max-kept-checkpoints", type=int, default=d.max_kept_checkpoints)
+    p.add_argument("--use-torch-distributed-ckpt", "--sharded-checkpoint",
+                   dest="sharded_checkpoint", action="store_true",
+                   help="Sharded multi-host checkpoint (Orbax/tensorstore).")
+    p.add_argument("--no-async-checkpoint", action="store_true")
+
+    # time-aware (utils.py:233-248)
+    p.add_argument("--timeaware-checkpointing", action="store_true")
+    p.add_argument("--default-iter-time", type=float, default=d.default_iter_time)
+    p.add_argument("--default-ckpt-time", type=float, default=d.default_ckpt_time)
+    p.add_argument("--job-end-time", type=float, default=None,
+                   help="Unix seconds; default from $JOB_END_TIME or $SLURM_JOB_END_TIME.")
+
+    # observability (utils.py:152-170, 249-254)
+    p.add_argument("--logging-frequency", type=int, default=d.logging_frequency)
+    p.add_argument("--log-loss-to-csv", action="store_true")
+    p.add_argument("--profile", action="store_true")
+    p.add_argument("--profile-step-start", type=int, default=d.profile_step_start)
+    p.add_argument("--profile-step-end", type=int, default=d.profile_step_end)
+    p.add_argument("--profile-dir", type=str, default=d.profile_dir)
+    return p
+
+
+def get_args(argv=None):
+    """Parse CLI args into a TrainConfig (reference `get_args`, utils.py:105)."""
+    ns = build_parser().parse_args(argv)
+    model = ModelConfig(
+        dim=ns.model_dim,
+        n_layers=ns.model_layers,
+        n_heads=ns.model_heads,
+        n_kv_heads=ns.model_kv_heads,
+        vocab_size=ns.vocab_size,
+    )
+    return TrainConfig(
+        dataset=ns.dataset,
+        tokenizer_name_or_path=ns.tokenizer_name_or_path,
+        sequence_length=ns.sequence_length,
+        batch_size=ns.batch_size,
+        training_samples=ns.training_samples,
+        learning_rate=ns.learning_rate,
+        lr_warmup_steps=ns.lr_warmup_steps,
+        weight_decay=ns.weight_decay,
+        grad_max_norm=ns.grad_max_norm,
+        grad_clipping=not ns.no_grad_clipping,
+        training_steps=ns.training_steps,
+        seed=ns.seed,
+        model=model,
+        model_dtype=ns.model_dtype,
+        param_dtype=ns.param_dtype,
+        use_flash_attention=ns.use_flash_attention,
+        remat=ns.remat,
+        mesh=MeshConfig(data=ns.dp, fsdp=ns.fsdp, tensor=ns.tp, sequence=ns.sp),
+        distributed=ns.distributed,
+        checkpoint_dir=ns.checkpoint_dir,
+        checkpoint_frequency=ns.checkpoint_frequency,
+        resume_from_checkpoint=ns.resume_from_checkpoint,
+        experiment_name=ns.experiment_name,
+        verify_checkpoints=ns.verify_checkpoints,
+        max_kept_checkpoints=ns.max_kept_checkpoints,
+        sharded_checkpoint=ns.sharded_checkpoint,
+        async_checkpoint=not ns.no_async_checkpoint,
+        timeaware_checkpointing=ns.timeaware_checkpointing,
+        default_iter_time=ns.default_iter_time,
+        default_ckpt_time=ns.default_ckpt_time,
+        job_end_time=ns.job_end_time,
+        logging_frequency=ns.logging_frequency,
+        log_loss_to_csv=ns.log_loss_to_csv,
+        profile=ns.profile,
+        profile_step_start=ns.profile_step_start,
+        profile_step_end=ns.profile_step_end,
+        profile_dir=ns.profile_dir,
+    )

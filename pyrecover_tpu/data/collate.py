@@ -1,0 +1,22 @@
+"""Causal-LM collation: shift-by-one and pad masking.
+
+Parity with reference ``CollatorForCLM`` (dataset.py:38-61): given tokenized
+items of length seq_len+1, inputs are tokens[:-1], labels are tokens[1:]
+with pad positions set to IGNORE_INDEX (-100) so they drop out of the loss.
+"""
+
+import numpy as np
+
+from pyrecover_tpu.train_state import IGNORE_INDEX
+
+
+def collate_clm(items, pad_token_id):
+    """items: sequence of int32 arrays, each (seq_len + 1,).
+
+    Returns dict of numpy arrays: inputs (B, S) int32, labels (B, S) int32.
+    """
+    batch = np.stack(items).astype(np.int32)
+    inputs = batch[:, :-1]
+    labels = batch[:, 1:].copy()
+    labels[labels == pad_token_id] = IGNORE_INDEX
+    return {"inputs": inputs, "labels": labels}

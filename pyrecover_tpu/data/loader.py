@@ -1,0 +1,134 @@
+"""Mesh-aware data loader with background prefetch.
+
+Replaces the reference's `torch.utils.data.DataLoader` + `DistributedSampler`
+stack (train.py:69-84). Differences, all TPU-motivated:
+
+  * Each host materializes only ITS slice of the global batch (by
+    ``jax.process_index()``) and the slices are assembled into one global
+    jax.Array with ``jax.make_array_from_process_local_data`` — the
+    multi-host equivalent of DistributedSampler's rank sharding.
+  * Tokenization/collation runs in a background thread pool a few batches
+    ahead (bounded queue), because per-item Python work in the hot loop
+    starves a TPU (SURVEY hard-part #5); prefetch order is driven by the
+    deterministic StatefulSampler so resumability is unaffected.
+  * Device transfer is itself async (jax device_put returns immediately),
+    so H2D overlaps the previous step's compute.
+"""
+
+import queue
+import threading
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding
+
+from pyrecover_tpu.data.collate import collate_clm
+from pyrecover_tpu.parallel.sharding import batch_pspec
+
+
+class DataLoader:
+    def __init__(self, dataset, sampler, pad_token_id, mesh=None,
+                 prefetch=2, num_workers=4):
+        self.dataset = dataset
+        self.sampler = sampler
+        self.pad_token_id = pad_token_id
+        self.mesh = mesh
+        self.prefetch = max(int(prefetch), 0)
+        self.num_workers = max(int(num_workers), 1)
+        self._queue = None
+        self._thread = None
+        self._stop = threading.Event()
+        self._sharding = (
+            NamedSharding(mesh, batch_pspec()) if mesh is not None else None
+        )
+
+    # -- host slice of the global index batch --------------------------------
+    def _local_indices(self, global_indices):
+        n_proc = jax.process_count()
+        if n_proc == 1:
+            return global_indices
+        gbs = len(global_indices)
+        if gbs % n_proc != 0:
+            raise ValueError(
+                f"global batch {gbs} not divisible by process count {n_proc}"
+            )
+        per = gbs // n_proc
+        p = jax.process_index()
+        return global_indices[p * per : (p + 1) * per]
+
+    def _make_batch(self, global_indices):
+        local = self._local_indices(global_indices)
+        items = [self.dataset[i] for i in local]
+        batch = collate_clm(items, self.pad_token_id)
+        return batch
+
+    def _to_device(self, batch):
+        if self._sharding is None:
+            return {k: jax.numpy.asarray(v) for k, v in batch.items()}
+        return {
+            k: jax.make_array_from_process_local_data(self._sharding, v)
+            for k, v in batch.items()
+        }
+
+    # -- background prefetch -------------------------------------------------
+    def _producer(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
+            pending = []
+            while not self._stop.is_set():
+                while len(pending) < self.num_workers:
+                    idx = self.sampler.next_batch()
+                    epoch = self.sampler.epoch
+                    pending.append((epoch, pool.submit(self._make_batch, idx)))
+                epoch, fut = pending.pop(0)
+                try:
+                    batch = fut.result()
+                except Exception as e:  # surface in consumer
+                    self._queue.put(e)
+                    return
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put((epoch, batch), timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+    def start(self):
+        if self.prefetch > 0 and self._thread is None:
+            self._queue = queue.Queue(maxsize=self.prefetch)
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._producer, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            # drain so the producer can observe the stop flag
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __next__(self):
+        """Returns (epoch, device_batch)."""
+        if self.prefetch > 0:
+            if self._thread is None:
+                self.start()
+            item = self._queue.get()
+            if isinstance(item, Exception):
+                raise item
+            epoch, batch = item
+        else:
+            idx = self.sampler.next_batch()
+            epoch = self.sampler.epoch
+            batch = self._make_batch(idx)
+        return epoch, self._to_device(batch)
+
+    def __iter__(self):
+        while True:
+            yield next(self)

@@ -1,0 +1,49 @@
+"""Parquet-backed text dataset with on-the-fly tokenization.
+
+Parity with reference ``ParquetDataset`` (dataset.py:10-35): memory-mapped
+parquet of a ``text`` column, virtual length with index wraparound, per-item
+tokenization to seq_len+1 with right-padding and truncation. The hot-loop
+tokenization cost the reference pays per step (SURVEY hard-part #5) is
+hidden by the DataLoader's background prefetch pool, not by this class.
+"""
+
+import numpy as np
+
+
+class ParquetTextDataset:
+    def __init__(self, parquet_file, tokenizer, seq_len, training_samples=0,
+                 text_column="text"):
+        import pyarrow.parquet as pq
+
+        table = pq.read_table(parquet_file, memory_map=True, columns=[text_column])
+        self.texts = table.column(text_column)
+        self.real_length = len(self.texts)
+        self.num_samples = int(training_samples) if training_samples else self.real_length
+        self.tokenizer = tokenizer
+        self.seq_len = int(seq_len)
+        self.pad_token_id = tokenizer.pad_token_id
+        if self.pad_token_id is None:
+            # common for base LMs: fall back to eos (same move HF trainers make)
+            self.pad_token_id = tokenizer.eos_token_id
+
+    def __len__(self):
+        return self.num_samples
+
+    def __getitem__(self, idx):
+        text = str(self.texts[int(idx) % self.real_length])
+        enc = self.tokenizer(
+            text,
+            max_length=self.seq_len + 1,
+            padding="max_length",
+            truncation=True,
+            return_attention_mask=False,
+        )
+        return np.asarray(enc["input_ids"], dtype=np.int32)
+
+
+def load_tokenizer(name_or_path):
+    """HF AutoTokenizer (reference train.py:54); deferred import so the
+    synthetic path needs no `transformers`."""
+    from transformers import AutoTokenizer
+
+    return AutoTokenizer.from_pretrained(name_or_path)

@@ -1,0 +1,120 @@
+"""The single checkpointable training-state pytree and the jitted train step.
+
+Design stance (SURVEY §7): everything the reference scatters across mutable
+objects — model weights, optimizer state, LR-schedule position, RNG, loop
+counters (`train.py` + `checkpoint.py:58-73`) — lives in ONE functional
+pytree. A checkpoint is exactly this pytree (plus the host-side data-order
+state); bit-exact resume is therefore structural, not effortful.
+
+The loss matches the reference's normalization exactly: sum-reduced
+cross-entropy on fp32 logits divided by the number of non-masked tokens
+(`train.py:263-266`) — the normalization the reference calls out as critical
+for resume parity.
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from pyrecover_tpu.models.llama import forward
+
+IGNORE_INDEX = -100  # label mask value (reference dataset.py:50-55)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jax.Array  # int32 scalar
+    epoch: jax.Array  # int32 scalar (reference tracks epoch alongside step)
+    rng: jax.Array  # raw uint32 key data (jax.random.key_data form)
+
+    def next_key(self):
+        return jax.random.wrap_key_data(self.rng)
+
+
+def create_train_state(rng, model_config, optimizer, params=None):
+    from pyrecover_tpu.models.llama import init_params
+
+    if params is None:
+        params = init_params(rng, model_config)
+    opt_state = optimizer.init(params)
+    return TrainState(
+        params=params,
+        opt_state=opt_state,
+        step=jnp.zeros((), dtype=jnp.int32),
+        epoch=jnp.zeros((), dtype=jnp.int32),
+        rng=jax.random.key_data(rng),
+    )
+
+
+def masked_cross_entropy(logits, labels):
+    """Sum-reduced CE over non-masked tokens / count (reference train.py:263-266).
+
+    Returns (loss, n_valid_tokens).
+    """
+    valid = labels != IGNORE_INDEX
+    safe_labels = jnp.where(valid, labels, 0)
+    logprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    token_ll = jnp.take_along_axis(logprobs, safe_labels[..., None], axis=-1)[..., 0]
+    loss_sum = -jnp.sum(jnp.where(valid, token_ll, 0.0))
+    n_valid = jnp.sum(valid)
+    return loss_sum / jnp.maximum(n_valid, 1).astype(jnp.float32), n_valid
+
+
+def make_train_step(model_config, optimizer, donate=True):
+    """Build the jitted functional train step.
+
+    state, batch → new_state, metrics. Under a mesh, batch/params shardings
+    propagate through (GSPMD); the DP gradient AllReduce the reference gets
+    from DDP (`train.py:268-269`) is inserted by XLA automatically.
+    """
+
+    def step_fn(state, batch):
+        def loss_fn(params):
+            logits = forward(params, batch["inputs"], model_config)
+            return masked_cross_entropy(logits, batch["labels"])
+
+        (loss, n_valid), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        updates, new_opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        new_params = optax.apply_updates(state.params, updates)
+        grad_norm = optax.global_norm(grads)
+        new_rng = jax.random.key_data(
+            jax.random.fold_in(jax.random.wrap_key_data(state.rng), 1)
+        )
+        new_state = TrainState(
+            params=new_params,
+            opt_state=new_opt_state,
+            step=state.step + 1,
+            epoch=state.epoch,
+            rng=new_rng,
+        )
+        metrics = {
+            "loss": loss,
+            "n_tokens": n_valid,
+            "grad_norm": grad_norm,
+        }
+        return new_state, metrics
+
+    donate_argnums = (0,) if donate else ()
+    return jax.jit(step_fn, donate_argnums=donate_argnums)
+
+
+def eval_loss_fn(model_config):
+    """Jitted forward+loss only (no update) — used by tests and verification."""
+
+    @partial(jax.jit)
+    def fn(params, batch):
+        logits = forward(params, batch["inputs"], model_config)
+        return masked_cross_entropy(logits, batch["labels"])[0]
+
+    return fn
