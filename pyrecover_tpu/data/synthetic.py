@@ -29,9 +29,20 @@ class SyntheticTextDataset:
         idx = int(idx) % self.num_samples  # wraparound (reference dataset.py:25-28)
         rng = np.random.Generator(np.random.Philox(key=[self.seed, idx]))
         n = self.seq_len + 1
-        tokens = rng.integers(1, self.vocab_size, size=n, dtype=np.int64).astype(
-            np.int32
-        )
+        # Learnable structure: an affine bigram recurrence over the non-pad
+        # vocab — next-token is a deterministic function of the current
+        # token, so models can actually drive the loss down (random tokens
+        # would make convergence tests meaningless). The start token is the
+        # only randomness per item.
+        m = self.vocab_size - 1
+        start = int(rng.integers(0, m))
+        a, c = 5, 7
+        tokens = np.empty(n, dtype=np.int64)
+        t = start
+        for i in range(n):
+            tokens[i] = t
+            t = (a * t + c) % m
+        tokens = (tokens + 1).astype(np.int32)  # keep 0 free for pad
         # deterministic variable-length "document": 0-25% pad tail
         doc_len = n - int(rng.integers(0, max(n // 4, 1)))
         tokens[doc_len:] = self.pad_token_id
