@@ -1,0 +1,117 @@
+"""Observability: throughput, MFU, TFLOPs, per-step loss CSV.
+
+Parity with the reference's metrics block (train.py:277-296): every
+``logging_frequency`` steps emit loss, tokens/sec, the fraction of non-pad
+training tokens, MFU, and TFLOP/s — but the MFU denominator is the actual
+per-chip TPU peak (utils/perf.py) instead of the hard-coded H100 989e12
+(reference defect #7, train.py:287). The per-step loss CSV
+(`<exp_dir>/<exp>_loss_log.csv`, train.py:143-151) is host-0-only.
+"""
+
+import csv
+import time
+from pathlib import Path
+
+import jax
+
+from pyrecover_tpu.utils.logging import log_host0
+from pyrecover_tpu.utils.perf import get_num_flop_per_token, tpu_peak_flops
+
+
+class LossCSVLogger:
+    """Rank-0 per-step (step, loss) CSV (reference train.py:143-151, 277-280)."""
+
+    def __init__(self, exp_dir, experiment_name, enabled=True):
+        self.enabled = enabled and jax.process_index() == 0
+        self._file = None
+        self._writer = None
+        if self.enabled:
+            exp_dir = Path(exp_dir)
+            exp_dir.mkdir(parents=True, exist_ok=True)
+            path = exp_dir / f"{experiment_name}_loss_log.csv"
+            self._file = open(path, "w", newline="")
+            self._writer = csv.writer(self._file)
+            self._writer.writerow(["step", "loss"])
+
+    def log(self, step, loss):
+        if self._writer is not None:
+            self._writer.writerow([int(step), float(loss)])
+
+    def close(self):
+        if self._file is not None:
+            self._file.flush()
+            self._file.close()
+            self._file = None
+
+
+class ThroughputMeter:
+    """Windowed tokens/sec + MFU accounting between logging points."""
+
+    def __init__(self, model_config, num_params, seq_len, n_devices=None):
+        self.flop_per_token = get_num_flop_per_token(
+            num_params,
+            model_config.n_layers,
+            model_config.n_heads,
+            model_config.head_dim,
+            seq_len,
+        )
+        self.peak_flops = tpu_peak_flops()
+        self.n_devices = n_devices or jax.device_count()
+        self.seq_len = seq_len
+        self.reset()
+
+    def reset(self):
+        self._t0 = time.monotonic()
+        self._tokens = 0  # non-pad tokens actually trained on
+        self._positions = 0  # total token positions processed (incl. pad)
+        self._steps = 0
+
+    def update(self, n_tokens, batch_size):
+        self._tokens += int(n_tokens)
+        self._positions += int(batch_size) * self.seq_len
+        self._steps += 1
+
+    def snapshot(self):
+        dt = max(time.monotonic() - self._t0, 1e-9)
+        tokens_per_sec = self._positions / dt
+        flops = self.flop_per_token * self._positions
+        tflops = flops / dt / 1e12
+        mfu = flops / dt / (self.peak_flops * self.n_devices) * 100.0
+        training_pct = 100.0 * self._tokens / max(self._positions, 1)
+        return {
+            "tokens_per_sec": tokens_per_sec,
+            "tokens_per_sec_per_chip": tokens_per_sec / self.n_devices,
+            "tflops": tflops,
+            "mfu_pct": mfu,
+            "training_tokens_pct": training_pct,
+            "seconds": dt,
+            "steps": self._steps,
+        }
+
+    def log(self, step, epoch, loss):
+        snap = self.snapshot()
+        log_host0(
+            "step %d | epoch %d | loss %.4f | %.0f tok/s (%.0f/chip) | "
+            "%.1f%% training tokens | %.2f TFLOP/s | MFU %.2f%%",
+            step, epoch, loss,
+            snap["tokens_per_sec"], snap["tokens_per_sec_per_chip"],
+            snap["training_tokens_pct"], snap["tflops"], snap["mfu_pct"],
+        )
+        self.reset()
+        return snap
+
+
+class WallTimeTotals:
+    """Cumulative train / ckpt-save / ckpt-load wall time, logged at exit
+    (reference train.py:381-398)."""
+
+    def __init__(self):
+        self.train_s = 0.0
+        self.ckpt_save_s = 0.0
+        self.ckpt_load_s = 0.0
+
+    def summary(self):
+        return (
+            f"total train {self.train_s:.1f}s | "
+            f"ckpt save {self.ckpt_save_s:.1f}s | ckpt load {self.ckpt_load_s:.1f}s"
+        )

@@ -1,0 +1,156 @@
+"""Time-aware checkpointing and preemption handling.
+
+Capability parity with the reference's signature feature (train.py:163-190,
+223-232, 298-307, 334-375 + submit-training-simple.sh:29-47): watch the job
+deadline, learn the real iteration/checkpoint durations online, and trigger
+one final checkpoint + graceful exit before the scheduler kills the job.
+Re-designed for TPU:
+
+  * Deadline sources, in priority order: an explicit ``--job-end-time``,
+    the ``JOB_END_TIME`` / ``SLURM_JOB_END_TIME`` env vars (reference
+    dist_utils.py:93-101), and — TPU-native — a *preemption notice file*
+    whose appearance means "save now" (Cloud TPU maintenance events /
+    queued-resource eviction and SIGTERM both funnel into it; see
+    ``install_signal_handler``).
+  * Adaptive safety buffer: thresholds start from ``--default-iter-time`` /
+    ``--default-ckpt-time`` and track observed maxima (reference
+    train.py:298-307, 334-337). The reference's two inconsistent buffer
+    formulas (init 10·iter+2·ckpt vs steady 5·iter+1·ckpt — SURVEY §2.3
+    defect 9) are collapsed to one: ``5·iter + 2·ckpt``.
+  * Decision protocol: host 0 decides, the decision is broadcast to every
+    host (reference train.py:342-346's rank-0 + broadcast shape) via
+    ``broadcast_host0_scalar`` — no distributed-decision races.
+
+The missing-by-design resubmission API of the reference
+(`pyrecover/__init__.py:5-7` imports modules that don't exist) is
+implemented here for real: ``write_requeue_marker`` drops a marker the
+launcher (launch/run_resilient.sh) uses to decide whether to restart with
+``--resume-from-checkpoint=latest``.
+"""
+
+import os
+import signal
+import time
+from pathlib import Path
+
+from pyrecover_tpu.parallel.mesh import broadcast_host0_scalar
+from pyrecover_tpu.utils.logging import log_host0
+
+PREEMPT_NOTICE_ENV = "PYRECOVER_PREEMPT_FILE"
+REQUEUE_MARKER = "REQUEUE"
+DONE_MARKER = "DONE"
+
+
+def get_job_end_time(explicit=None):
+    """Deadline in unix seconds, or None (reference dist_utils.py:93-101)."""
+    if explicit is not None:
+        return float(explicit)
+    for var in ("JOB_END_TIME", "SLURM_JOB_END_TIME"):
+        val = os.environ.get(var)
+        if val:
+            try:
+                return float(val)
+            except ValueError:
+                pass
+    return None
+
+
+class PreemptionWatcher:
+    """Host-0 deadline/notice watcher with online duration learning."""
+
+    def __init__(self, *, enabled, default_iter_time=1.0,
+                 default_ckpt_time=10.0, job_end_time=None,
+                 notice_file=None):
+        self.enabled = enabled
+        self.job_end_time = get_job_end_time(job_end_time)
+        self.max_iter_time = float(default_iter_time)
+        self.max_ckpt_time = float(default_ckpt_time)
+        notice = notice_file or os.environ.get(PREEMPT_NOTICE_ENV)
+        self.notice_file = Path(notice) if notice else None
+        self._signal_seen = False
+        if self.enabled:
+            if self.job_end_time is not None:
+                log_host0(
+                    "Time-aware checkpointing armed: %.0f s of walltime remain",
+                    self.job_end_time - time.time(),
+                )
+            else:
+                log_host0(
+                    "Time-aware checkpointing enabled with no deadline source; "
+                    "watching preemption notices only"
+                )
+
+    # -- online learning of durations (reference train.py:298-307, 334-337) --
+    def observe_iter(self, seconds):
+        if seconds > self.max_iter_time:
+            self.max_iter_time = seconds
+
+    def observe_ckpt(self, seconds):
+        if seconds > self.max_ckpt_time:
+            self.max_ckpt_time = seconds
+
+    @property
+    def safety_buffer(self):
+        return 5.0 * self.max_iter_time + 2.0 * self.max_ckpt_time
+
+    # -- signal / notice integration -----------------------------------------
+    def install_signal_handler(self):
+        """SIGTERM/SIGUSR1 → treat as a preemption notice. Cloud TPU
+        maintenance sends SIGTERM ahead of eviction; SLURM can be configured
+        to send SIGUSR1 before the wall limit."""
+
+        def handler(signum, frame):
+            self._signal_seen = True
+
+        signal.signal(signal.SIGTERM, handler)
+        try:
+            signal.signal(signal.SIGUSR1, handler)
+        except (ValueError, OSError):
+            pass
+        return self
+
+    def _notice_present(self):
+        if self._signal_seen:
+            return True
+        return self.notice_file is not None and self.notice_file.exists()
+
+    # -- the per-step decision (host 0 decides, all hosts agree) --------------
+    def should_stop(self):
+        """Called once per step. Returns True on every host when it is time
+        to take the final checkpoint and exit."""
+        if not self.enabled:
+            return False
+        decision = False
+        reason = None
+        if self._notice_present():
+            decision = True
+            reason = "preemption notice received"
+        elif self.job_end_time is not None:
+            time_left = self.job_end_time - time.time()
+            threshold = self.max_iter_time + self.max_ckpt_time + self.safety_buffer
+            if time_left < threshold:
+                decision = True
+                reason = (
+                    f"{time_left:.0f} s left < threshold {threshold:.0f} s "
+                    f"(iter {self.max_iter_time:.2f} s, ckpt {self.max_ckpt_time:.2f} s)"
+                )
+        decision = bool(broadcast_host0_scalar(decision))
+        if decision and reason:
+            log_host0("Stopping for final checkpoint: %s", reason)
+        return decision
+
+
+def write_requeue_marker(exp_dir, *, done=False):
+    """Publish the restart decision for the launcher: REQUEUE means the run
+    stopped early (deadline/preemption) and should be resubmitted with
+    --resume-from-checkpoint=latest; DONE means training finished."""
+    import jax
+
+    if jax.process_index() != 0:
+        return
+    exp_dir = Path(exp_dir)
+    exp_dir.mkdir(parents=True, exist_ok=True)
+    marker = exp_dir / (DONE_MARKER if done else REQUEUE_MARKER)
+    other = exp_dir / (REQUEUE_MARKER if done else DONE_MARKER)
+    other.unlink(missing_ok=True)
+    marker.write_text(str(time.time()))
