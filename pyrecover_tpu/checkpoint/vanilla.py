@@ -72,8 +72,8 @@ def save_ckpt_vanilla(path, state, sampler_state=None, *, verify=False,
     path = Path(path)
     sync_global_devices("vanilla_save_enter")
 
-    leaves, treedef = jax.tree_util.tree_flatten(state)
-    np_leaves = [_leaf_to_numpy(x) for x in leaves]  # allgather runs on ALL hosts
+    path_leaves, treedef = jax.tree_util.tree_flatten_with_path(state)
+    np_leaves = [_leaf_to_numpy(x) for _, x in path_leaves]  # allgather on ALL hosts
 
     if jax.process_index() == 0:
         path.parent.mkdir(parents=True, exist_ok=True)
@@ -81,6 +81,8 @@ def save_ckpt_vanilla(path, state, sampler_state=None, *, verify=False,
             "format": FORMAT_VERSION,
             "num_leaves": len(np_leaves),
             "treedef": str(treedef),
+            # leaf key-paths, for the equality CLI and cross-format comparison
+            "paths": [jax.tree_util.keystr(p) for p, _ in path_leaves],
             "sampler": sampler_state or {},
         }
         if extra_meta:

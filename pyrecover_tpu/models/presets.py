@@ -1,0 +1,43 @@
+"""Named model configurations.
+
+``llama_8b`` is the reference's hard-coded default run shape
+(train.py:88-99: dim 4096, 32 layers, GQA 32/8, ffn_mult 1.3 → hidden 14336,
+vocab 131072 from the Mistral-Nemo tokenizer — ≈8.05B params).
+``llama_1b`` is the BASELINE.md benchmark point (~1B params);
+the smaller presets are for tests and CI.
+"""
+
+from pyrecover_tpu.models.llama import ModelConfig
+
+
+def llama_8b(max_seq_len=2048, vocab_size=131072):
+    return ModelConfig(
+        dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        ffn_dim_multiplier=1.3, multiple_of=1024, rope_theta=500000.0,
+        vocab_size=vocab_size, max_seq_len=max_seq_len,
+    )
+
+
+def llama_1b(max_seq_len=2048, vocab_size=32768):
+    """≈1.2B params: dim 2048, 20 layers, GQA 16/8, ffn hidden 7168."""
+    return ModelConfig(
+        dim=2048, n_layers=20, n_heads=16, n_kv_heads=8,
+        ffn_dim_multiplier=1.3, multiple_of=1024, rope_theta=500000.0,
+        vocab_size=vocab_size, max_seq_len=max_seq_len,
+    )
+
+
+def llama_150m(max_seq_len=1024, vocab_size=32768):
+    """≈150M params: dim 768, 12 layers, GQA 12/4."""
+    return ModelConfig(
+        dim=768, n_layers=12, n_heads=12, n_kv_heads=4,
+        ffn_dim_multiplier=1.0, multiple_of=256, rope_theta=500000.0,
+        vocab_size=vocab_size, max_seq_len=max_seq_len,
+    )
+
+
+PRESETS = {
+    "llama-8b": llama_8b,
+    "llama-1b": llama_1b,
+    "llama-150m": llama_150m,
+}
