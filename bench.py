@@ -47,11 +47,12 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="llama-1b")
     ap.add_argument("--seq-len", type=int, default=2048)
-    ap.add_argument("--batch-size", type=int, default=4)
+    ap.add_argument("--batch-size", type=int, default=8)
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--skip-ckpt", action="store_true")
     ap.add_argument("--learning-rate", type=float, default=3e-4)
+    ap.add_argument("--loss-chunk-size", type=int, default=512)
     args = ap.parse_args()
 
     n_devices = jax.device_count()
@@ -97,7 +98,7 @@ def main():
     )
     sampler = StatefulSampler(dataset_len=1024, global_batch_size=args.batch_size)
     loader = DataLoader(ds, sampler, pad_token_id=0, mesh=mesh, prefetch=2).start()
-    step_fn = make_train_step(model_cfg, optimizer)
+    step_fn = make_train_step(model_cfg, optimizer, loss_chunk_size=args.loss_chunk_size)
 
     with jax.sharding.set_mesh(mesh):
         # warmup (compile)

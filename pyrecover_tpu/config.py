@@ -46,6 +46,7 @@ class TrainConfig:
     param_dtype: str = "fp32"  # master weights; TPU-native improvement over all-bf16
     use_flash_attention: bool = False
     remat: bool = False
+    loss_chunk_size: int = 0  # >0: fused chunked CE, never materializes full logits
     # -- parallelism ---------------------------------------------------------
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     distributed: bool = False  # accepted for parity; mesh is always used
@@ -127,6 +128,9 @@ def build_parser():
                    dest="use_flash_attention", action="store_true")
     p.add_argument("--remat", action="store_true",
                    help="Rematerialize transformer blocks (trade FLOPs for HBM).")
+    p.add_argument("--loss-chunk-size", type=int, default=0,
+                   help=">0: compute the CE loss in sequence chunks of this size, "
+                        "fusing the vocab projection (HBM saver for big vocabs).")
 
     # parallelism (new; the reference's --distributed has no shape control)
     p.add_argument("--distributed", action="store_true")
@@ -193,6 +197,7 @@ def get_args(argv=None):
         param_dtype=ns.param_dtype,
         use_flash_attention=ns.use_flash_attention,
         remat=ns.remat,
+        loss_chunk_size=ns.loss_chunk_size,
         mesh=MeshConfig(data=ns.dp, fsdp=ns.fsdp, tensor=ns.tp, sequence=ns.sp),
         distributed=ns.distributed,
         checkpoint_dir=ns.checkpoint_dir,

@@ -186,14 +186,13 @@ def _block(x, layer, cos, sin, config, attn_fn):
     return x
 
 
-def forward(params, tokens, config):
-    """Forward pass: tokens (batch, seq) int32 → logits (batch, seq, vocab) fp32.
-
-    Mirrors reference `Transformer.forward` (model.py:376-395): embed →
-    n_layers pre-norm blocks → final RMSNorm → untied vocab projection.
-    Logits are returned in fp32 (the reference casts in its loss,
-    train.py:263-266).
-    """
+def forward_hidden(params, tokens, config):
+    """Embed → n_layers pre-norm blocks → final RMSNorm; returns the hidden
+    states (batch, seq, dim) BEFORE the vocab projection. Split out so the
+    loss can fuse projection + cross-entropy per sequence chunk without ever
+    materializing (batch, seq, vocab) logits (an HBM-bandwidth/capacity
+    optimization the reference, which always materializes full logits at
+    train.py:262-266, has no analogue of)."""
     cfg = config
     cdt = resolve_dtype(cfg.compute_dtype)
     seq_len = tokens.shape[1]
@@ -215,10 +214,25 @@ def forward(params, tokens, config):
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
 
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return rms_norm(x, params["final_norm"], cfg.norm_eps)
+
+
+def project_vocab(params, hidden, config):
+    """Untied vocab projection (reference model.py:367,394), fp32 logits."""
+    cdt = resolve_dtype(config.compute_dtype)
     logits = jnp.einsum(
-        "bsd,dv->bsv", x, params["output"].astype(cdt),
+        "bsd,dv->bsv", hidden, params["output"].astype(cdt),
         preferred_element_type=jnp.float32,
     )
-    logits = constrain(logits, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, AXIS_TENSOR)
-    return logits
+    return constrain(logits, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, AXIS_TENSOR)
+
+
+def forward(params, tokens, config):
+    """Forward pass: tokens (batch, seq) int32 → logits (batch, seq, vocab) fp32.
+
+    Mirrors reference `Transformer.forward` (model.py:376-395): embed →
+    n_layers pre-norm blocks → final RMSNorm → untied vocab projection.
+    Logits are returned in fp32 (the reference casts in its loss,
+    train.py:263-266).
+    """
+    return project_vocab(params, forward_hidden(params, tokens, config), config)

@@ -194,7 +194,7 @@ def train(config: TrainConfig):
         prefetch=2, num_workers=4,
     ).start()
 
-    step_fn = make_train_step(model_config, optimizer)
+    step_fn = make_train_step(model_config, optimizer, loss_chunk_size=config.loss_chunk_size)
     meter = ThroughputMeter(
         model_config, n_params, config.sequence_length, jax.device_count()
     )

@@ -67,16 +67,54 @@ def masked_cross_entropy(logits, labels):
     return loss_sum / jnp.maximum(n_valid, 1).astype(jnp.float32), n_valid
 
 
-def make_train_step(model_config, optimizer, donate=True):
+def chunked_loss(params, tokens, labels, model_config, chunk_size):
+    """Fused projection + CE over sequence chunks: never materializes the
+    full (batch, seq, vocab) logits — the dominant HBM cost of the naive
+    loss at LLM vocab sizes. ``lax.map`` over chunks keeps one chunk of
+    logits live at a time (in fwd AND in the scanned backward)."""
+    from pyrecover_tpu.models.llama import forward_hidden, project_vocab
+
+    hidden = forward_hidden(params, tokens, model_config)
+    b, s, d = hidden.shape
+    if chunk_size <= 0 or s % chunk_size or s == chunk_size:
+        logits = project_vocab(params, hidden, model_config)
+        return masked_cross_entropy(logits, labels)
+
+    n = s // chunk_size
+    h_chunks = jnp.moveaxis(hidden.reshape(b, n, chunk_size, d), 1, 0)
+    l_chunks = jnp.moveaxis(labels.reshape(b, n, chunk_size), 1, 0)
+
+    def per_chunk(args):
+        h, lab = args
+        logits = project_vocab(params, h, model_config)
+        valid = lab != IGNORE_INDEX
+        safe = jnp.where(valid, lab, 0)
+        logprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logprobs, safe[..., None], axis=-1)[..., 0]
+        return -jnp.sum(jnp.where(valid, ll, 0.0)), jnp.sum(valid)
+
+    sums, counts = jax.lax.map(per_chunk, (h_chunks, l_chunks))
+    n_valid = jnp.sum(counts)
+    return jnp.sum(sums) / jnp.maximum(n_valid, 1).astype(jnp.float32), n_valid
+
+
+def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0):
     """Build the jitted functional train step.
 
     state, batch → new_state, metrics. Under a mesh, batch/params shardings
     propagate through (GSPMD); the DP gradient AllReduce the reference gets
     from DDP (`train.py:268-269`) is inserted by XLA automatically.
+    ``loss_chunk_size`` > 0 enables the chunked fused loss (see
+    ``chunked_loss``).
     """
 
     def step_fn(state, batch):
         def loss_fn(params):
+            if loss_chunk_size > 0:
+                return chunked_loss(
+                    params, batch["inputs"], batch["labels"],
+                    model_config, loss_chunk_size,
+                )
             logits = forward(params, batch["inputs"], model_config)
             return masked_cross_entropy(logits, batch["labels"])
 
