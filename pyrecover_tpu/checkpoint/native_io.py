@@ -1,0 +1,131 @@
+"""ctypes binding for the native checkpoint-I/O engine (native/pyrecover_io.cpp).
+
+Auto-builds the shared library with g++ on first use (single translation
+unit, ~1 s) and degrades gracefully: every caller must handle
+``available() == False`` (no compiler / unsupported platform), in which case
+the pure-Python hashlib path in ``vanilla.py`` is used. The binding is
+kept ctypes-only so no build step is required at install time (pybind11 is
+deliberately not a dependency).
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+DEFAULT_CHUNK = 16 * 1024 * 1024
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_SRC = Path(__file__).resolve().parent.parent.parent / "native" / "pyrecover_io.cpp"
+_BUILD_DIR = _SRC.parent / "build"
+_SO = _BUILD_DIR / "libpyrecover_io.so"
+
+
+def _build():
+    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
+        "-o", str(_SO), str(_SRC),
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        try:
+            if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
+                _build()
+            lib = ctypes.CDLL(str(_SO))
+            lib.pr_xxh64.restype = ctypes.c_uint64
+            lib.pr_xxh64.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            lib.pr_tree_hash.restype = ctypes.c_uint64
+            lib.pr_tree_hash.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int
+            ]
+            lib.pr_write_file.restype = ctypes.c_uint64
+            lib.pr_write_file.argtypes = [
+                ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint64,
+                ctypes.c_uint64, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+            ]
+            lib.pr_read_file.restype = ctypes.c_uint64
+            lib.pr_read_file.argtypes = [
+                ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint64,
+                ctypes.c_uint64, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+            ]
+            lib.pr_hash_file.restype = ctypes.c_uint64
+            lib.pr_hash_file.argtypes = [
+                ctypes.c_char_p, ctypes.c_uint64, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int),
+            ]
+            lib.pr_file_size.restype = ctypes.c_uint64
+            lib.pr_file_size.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_int)
+            ]
+            _lib = lib
+        except Exception:
+            _lib = None
+        return _lib
+
+
+def available():
+    return _load() is not None
+
+
+def _check(err, op, path):
+    if err.value != 0:
+        raise OSError(err.value, f"native {op} failed for {path}: "
+                                 f"{os.strerror(err.value)}")
+
+
+def xxh64(data: bytes) -> int:
+    lib = _load()
+    return int(lib.pr_xxh64(data, len(data)))
+
+
+def tree_hash(data, chunk=DEFAULT_CHUNK, n_threads=0) -> int:
+    lib = _load()
+    buf = (ctypes.c_char * len(data)).from_buffer_copy(data) if not isinstance(
+        data, (bytes, bytearray)) else data
+    return int(lib.pr_tree_hash(bytes(buf) if not isinstance(buf, (bytes, bytearray)) else buf,
+                                len(data), chunk, n_threads))
+
+
+def write_file(path, data: bytes, chunk=DEFAULT_CHUNK, n_threads=0) -> int:
+    """Parallel write + checksum-in-the-same-pass. Returns the tree hash."""
+    lib = _load()
+    err = ctypes.c_int(0)
+    digest = lib.pr_write_file(str(path).encode(), data, len(data), chunk,
+                               n_threads, ctypes.byref(err))
+    _check(err, "write", path)
+    return int(digest)
+
+
+def read_file(path, chunk=DEFAULT_CHUNK, n_threads=0):
+    """Parallel read of the whole file. Returns (bytes, tree_hash)."""
+    lib = _load()
+    err = ctypes.c_int(0)
+    size = lib.pr_file_size(str(path).encode(), ctypes.byref(err))
+    _check(err, "stat", path)
+    buf = ctypes.create_string_buffer(size)
+    digest = lib.pr_read_file(str(path).encode(), buf, size, chunk,
+                              n_threads, ctypes.byref(err))
+    _check(err, "read", path)
+    return bytes(buf.raw), int(digest)
+
+
+def hash_file(path, chunk=DEFAULT_CHUNK, n_threads=0) -> int:
+    """Streaming parallel tree checksum of a file."""
+    lib = _load()
+    err = ctypes.c_int(0)
+    digest = lib.pr_hash_file(str(path).encode(), chunk, n_threads,
+                              ctypes.byref(err))
+    _check(err, "hash", path)
+    return int(digest)

@@ -45,15 +45,52 @@ def _leaf_to_numpy(leaf):
     return np.asarray(leaf)
 
 
-def compute_checksum(path, chunk_size=16 * 1024 * 1024):
+_HASH_CHUNK = 16 * 1024 * 1024
+
+
+def compute_checksum(path):
+    """Self-describing checksum string. Prefers the native multithreaded
+    xxh64-tree engine (native/pyrecover_io.cpp); falls back to sha256."""
+    from pyrecover_tpu.checkpoint import native_io
+
+    if native_io.available():
+        digest = native_io.hash_file(path, chunk=_HASH_CHUNK)
+        return f"xxh64tree:{_HASH_CHUNK}:{digest:016x}"
     h = hashlib.sha256()
     with open(path, "rb") as f:
         while True:
-            chunk = f.read(chunk_size)
+            chunk = f.read(_HASH_CHUNK)
             if not chunk:
                 break
             h.update(chunk)
-    return h.hexdigest()
+    return f"sha256::{h.hexdigest()}"
+
+
+def verify_checksum(path, expected):
+    """Verify ``path`` against a checksum string from ``compute_checksum``.
+    Either implementation (native C++ / pure Python) can verify either
+    scheme, so checkpoints move freely between hosts."""
+    algo, param, digest = expected.strip().split(":", 2)
+    if algo == "xxh64tree":
+        from pyrecover_tpu.checkpoint import native_io
+        from pyrecover_tpu.utils import xxh
+
+        chunk = int(param)
+        if native_io.available():
+            actual = f"{native_io.hash_file(path, chunk=chunk):016x}"
+        else:
+            actual = f"{xxh.tree_hash_file(path, chunk):016x}"
+        return actual == digest
+    if algo == "sha256":
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            while True:
+                c = f.read(_HASH_CHUNK)
+                if not c:
+                    break
+                h.update(c)
+        return h.hexdigest() == digest
+    raise ValueError(f"Unknown checksum algorithm {algo!r}")
 
 
 def _sidecar(path):
@@ -93,16 +130,25 @@ def save_ckpt_vanilla(path, state, sampler_state=None, *, verify=False,
                 "leaves": {str(i): leaf for i, leaf in enumerate(np_leaves)},
             }
         )
+        from pyrecover_tpu.checkpoint import native_io
+
         fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=path.name, suffix=".tmp")
+        checksum = None
         try:
-            with os.fdopen(fd, "wb") as f:
-                f.write(payload)
+            if native_io.available():
+                # parallel pwrite + checksum computed in the same pass
+                os.close(fd)
+                digest = native_io.write_file(tmp, payload, chunk=_HASH_CHUNK)
+                checksum = f"xxh64tree:{_HASH_CHUNK}:{digest:016x}"
+            else:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(payload)
             os.replace(tmp, path)  # atomic publish
         finally:
             if os.path.exists(tmp):
                 os.unlink(tmp)
         if verify:
-            _sidecar(path).write_text(compute_checksum(path))
+            _sidecar(path).write_text(checksum or compute_checksum(path))
         if max_keep:
             prune_checkpoints(path.parent, max_keep, sharded=False)
 
@@ -132,16 +178,24 @@ def load_ckpt_vanilla(path, target_state, *, verify=False):
                 verify_error.append(f"checksum sidecar missing: {sidecar}")
                 return
             expected = sidecar.read_text().strip()
-            actual = compute_checksum(path)
-            if actual != expected:
-                verify_error.append(
-                    f"checksum mismatch for {path}: expected {expected}, got {actual}"
-                )
+            try:
+                ok = verify_checksum(path, expected)
+            except Exception as e:
+                verify_error.append(f"checksum verification failed for {path}: {e}")
+                return
+            if not ok:
+                verify_error.append(f"checksum mismatch for {path}: expected {expected}")
 
         verify_thread = threading.Thread(target=_verify, daemon=True)
         verify_thread.start()
 
-    raw = msgpack_restore(path.read_bytes())
+    from pyrecover_tpu.checkpoint import native_io
+
+    if native_io.available():
+        data, _ = native_io.read_file(path)  # parallel pread
+    else:
+        data = path.read_bytes()
+    raw = msgpack_restore(data)
     meta = json.loads(raw["meta"])
     if meta["format"] != FORMAT_VERSION:
         raise ValueError(f"Unsupported checkpoint format {meta['format']}")
