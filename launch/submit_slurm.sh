@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+#SBATCH --job-name=pyrecover-tpu
+#SBATCH --nodes=1
+#SBATCH --ntasks-per-node=1
+#SBATCH --time=00:40:00
+#
+# SLURM launcher — capability parity with the reference's
+# submit-training-simple.sh, re-targeted at TPU hosts:
+#   * computes the absolute job deadline and exports it (the reference's
+#     SLURM_JOB_END_TIME computation, submit-training-simple.sh:29-47) so
+#     --timeaware-checkpointing can plan the final checkpoint;
+#   * no MASTER_ADDR/MASTER_PORT/NCCL rendezvous — on TPU pods
+#     jax.distributed.initialize() discovers the slice topology from the
+#     runtime, so the launcher's only distributed job is starting one
+#     process per host (srun does that);
+#   * wraps the trainer in run_resilient.sh so preemption/deadline stops
+#     auto-resume (the reference needed a human re-sbatch with --continue).
+#
+# Usage: sbatch launch/submit_slurm.sh [pyrecover_tpu.train flags...]
+
+set -euo pipefail
+
+# ---- absolute deadline from the SLURM time limit -------------------------
+if [[ -n "${SLURM_JOB_ID:-}" ]] && command -v squeue >/dev/null 2>&1; then
+  # end time straight from the scheduler (robust to requeues/extensions)
+  END_ISO=$(squeue -h -j "$SLURM_JOB_ID" -o "%e")
+  if [[ -n "$END_ISO" && "$END_ISO" != "N/A" ]]; then
+    export SLURM_JOB_END_TIME=$(date -d "$END_ISO" +%s)
+    echo "Job deadline: $END_ISO (epoch $SLURM_JOB_END_TIME)"
+  fi
+fi
+
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+
+srun bash "${SCRIPT_DIR}/run_resilient.sh" \
+  --timeaware-checkpointing \
+  --verify-checkpoints \
+  "$@"

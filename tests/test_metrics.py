@@ -1,0 +1,70 @@
+"""Metrics/observability tests: FLOPs model, MFU denominators, CSV logger
+(reference train.py:277-296, utils.py:30-56)."""
+
+import csv
+
+import jax
+
+from pyrecover_tpu.metrics import LossCSVLogger, ThroughputMeter, WallTimeTotals
+from pyrecover_tpu.models import ModelConfig
+from pyrecover_tpu.utils.perf import (
+    get_num_flop_per_token,
+    get_num_params,
+    tpu_peak_flops,
+)
+
+
+def test_flops_model():
+    # 6N + 12·l·h·q·t (reference utils.py:41-56)
+    assert get_num_flop_per_token(100, 2, 4, 16, 128) == 600 + 12 * 2 * 4 * 16 * 128
+
+
+def test_num_params_excl_embedding():
+    from pyrecover_tpu.models import init_params
+
+    cfg = ModelConfig().tiny()
+    params = init_params(jax.random.key(0), cfg)
+    total = get_num_params(params)
+    no_embed = get_num_params(params, exclude_embedding=True)
+    assert total - no_embed == cfg.vocab_size * cfg.dim
+
+
+def test_tpu_peak_flops_table():
+    class FakeDev:
+        device_kind = "TPU v5 lite"
+
+    assert tpu_peak_flops(FakeDev()) == 197e12
+
+    class Unknown:
+        device_kind = "cpu"
+
+    assert tpu_peak_flops(Unknown()) == 1e12  # fallback, never zero
+
+
+def test_throughput_meter_counts():
+    cfg = ModelConfig().tiny()
+    meter = ThroughputMeter(cfg, num_params=1000, seq_len=32, n_devices=2)
+    meter.update(n_tokens=48, batch_size=2)  # 64 positions, 48 non-pad
+    snap = meter.snapshot()
+    assert snap["training_tokens_pct"] == 75.0
+    assert snap["steps"] == 1
+    assert snap["tokens_per_sec"] > 0
+    assert snap["tokens_per_sec_per_chip"] * 2 == snap["tokens_per_sec"]
+
+
+def test_loss_csv_logger(tmp_path):
+    logger = LossCSVLogger(tmp_path, "exp", enabled=True)
+    logger.log(1, 2.5)
+    logger.log(2, 2.25)
+    logger.close()
+    rows = list(csv.reader(open(tmp_path / "exp_loss_log.csv")))
+    assert rows[0] == ["step", "loss"]
+    assert rows[1] == ["1", "2.5"]
+    assert len(rows) == 3
+
+
+def test_walltime_totals_summary():
+    t = WallTimeTotals()
+    t.train_s, t.ckpt_save_s, t.ckpt_load_s = 10.0, 1.5, 0.5
+    s = t.summary()
+    assert "10.0" in s and "1.5" in s and "0.5" in s

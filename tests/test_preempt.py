@@ -1,0 +1,85 @@
+"""Preemption watcher unit tests: deadline math, adaptive thresholds,
+notice files, signals, requeue markers (reference train.py:163-190,
+223-232, 298-307 semantics)."""
+
+import os
+import signal
+import time
+
+import pytest
+
+from pyrecover_tpu.preempt import (
+    DONE_MARKER,
+    REQUEUE_MARKER,
+    PreemptionWatcher,
+    get_job_end_time,
+    write_requeue_marker,
+)
+
+
+def test_get_job_end_time_sources(monkeypatch):
+    assert get_job_end_time(123.0) == 123.0
+    monkeypatch.setenv("JOB_END_TIME", "456")
+    assert get_job_end_time() == 456.0
+    monkeypatch.delenv("JOB_END_TIME")
+    monkeypatch.setenv("SLURM_JOB_END_TIME", "789")
+    assert get_job_end_time() == 789.0
+    monkeypatch.delenv("SLURM_JOB_END_TIME")
+    assert get_job_end_time() is None
+    monkeypatch.setenv("SLURM_JOB_END_TIME", "not-a-number")
+    assert get_job_end_time() is None
+
+
+def test_disabled_watcher_never_stops():
+    w = PreemptionWatcher(enabled=False, job_end_time=time.time() - 100)
+    assert not w.should_stop()
+
+
+def test_deadline_triggers_stop():
+    w = PreemptionWatcher(
+        enabled=True, default_iter_time=1.0, default_ckpt_time=10.0,
+        job_end_time=time.time() + 5.0,  # < iter+ckpt+buffer = 11 + 25
+    )
+    assert w.should_stop()
+
+
+def test_far_deadline_does_not_stop():
+    w = PreemptionWatcher(
+        enabled=True, default_iter_time=1.0, default_ckpt_time=10.0,
+        job_end_time=time.time() + 3600.0,
+    )
+    assert not w.should_stop()
+
+
+def test_adaptive_thresholds_learn_maxima():
+    w = PreemptionWatcher(enabled=True, default_iter_time=1.0,
+                          default_ckpt_time=10.0, job_end_time=None)
+    w.observe_iter(3.5)
+    w.observe_iter(2.0)  # not a new max
+    w.observe_ckpt(25.0)
+    assert w.max_iter_time == 3.5
+    assert w.max_ckpt_time == 25.0
+    assert w.safety_buffer == pytest.approx(5 * 3.5 + 2 * 25.0)
+
+
+def test_notice_file_triggers_stop(tmp_path):
+    notice = tmp_path / "preempt-notice"
+    w = PreemptionWatcher(enabled=True, job_end_time=None, notice_file=notice)
+    assert not w.should_stop()
+    notice.write_text("evicting soon")
+    assert w.should_stop()
+
+
+def test_sigterm_triggers_stop():
+    w = PreemptionWatcher(enabled=True, job_end_time=None).install_signal_handler()
+    assert not w.should_stop()
+    os.kill(os.getpid(), signal.SIGUSR1)
+    assert w.should_stop()
+
+
+def test_requeue_and_done_markers(tmp_path):
+    write_requeue_marker(tmp_path, done=False)
+    assert (tmp_path / REQUEUE_MARKER).exists()
+    write_requeue_marker(tmp_path, done=True)
+    assert (tmp_path / DONE_MARKER).exists()
+    assert not (tmp_path / REQUEUE_MARKER).exists()  # mutually exclusive
