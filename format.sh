@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Lint/format harness (parity with reference format.sh).
+set -e
+python -m isort pyrecover_tpu tests tools bench.py __graft_entry__.py 2>/dev/null || true
+python -m black pyrecover_tpu tests tools bench.py __graft_entry__.py 2>/dev/null || true
+python -m flake8 --max-line-length 100 pyrecover_tpu 2>/dev/null || true
