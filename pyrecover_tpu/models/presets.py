@@ -41,3 +41,35 @@ PRESETS = {
     "llama-1b": llama_1b,
     "llama-150m": llama_150m,
 }
+
+
+def analytic_param_count(cfg):
+    """Closed-form parameter count (no initialization needed) — the
+    capability of the reference's model smoke test (test_model.py:6-25),
+    which instantiates the full 8B model just to count."""
+    hd = cfg.head_dim
+    ffn = cfg.ffn_hidden_dim
+    per_layer = (
+        2 * cfg.dim
+        + cfg.dim * cfg.n_heads * hd
+        + 2 * cfg.dim * cfg.n_kv_heads * hd
+        + cfg.n_heads * hd * cfg.dim
+        + 3 * cfg.dim * ffn
+    )
+    return (
+        cfg.vocab_size * cfg.dim
+        + cfg.n_layers * per_layer
+        + cfg.dim
+        + cfg.dim * cfg.vocab_size
+    )
+
+
+if __name__ == "__main__":
+    for name, fn in PRESETS.items():
+        cfg = fn()
+        n = analytic_param_count(cfg)
+        print(
+            f"{name}: {n:,} params ({n / 1e9:.2f}B) | dim {cfg.dim} x "
+            f"{cfg.n_layers}L | GQA {cfg.n_heads}/{cfg.n_kv_heads} | "
+            f"ffn {cfg.ffn_hidden_dim} | vocab {cfg.vocab_size}"
+        )

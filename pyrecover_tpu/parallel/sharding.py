@@ -17,8 +17,12 @@ from pyrecover_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_TEN
 
 # name of final pytree leaf key -> spec factory, keyed on leaf ndim
 _RULES = {
-    # embeddings: shard vocab on tensor, model dim on fsdp
-    "tok_embed": P(AXIS_TENSOR, AXIS_FSDP),
+    # embeddings: vocab replicated, model dim sharded over tensor×fsdp. A
+    # vocab-sharded table would need a masked-gather+psum per lookup, which
+    # XLA's SPMD partitioner handles by full rematerialization (observed:
+    # "Involuntary full rematerialization" on the embedding gather); a
+    # dim-sharded table makes the gather local and the later allgather tiny.
+    "tok_embed": P(None, (AXIS_TENSOR, AXIS_FSDP)),
     # attention projections, stacked over layers at dim 0:
     #   wq/wk/wv (L, D, heads*hd): column parallel — output dim on tensor
     "wq": P(None, AXIS_FSDP, AXIS_TENSOR),

@@ -68,3 +68,21 @@ def test_walltime_totals_summary():
     t.train_s, t.ckpt_save_s, t.ckpt_load_s = 10.0, 1.5, 0.5
     s = t.summary()
     assert "10.0" in s and "1.5" in s and "0.5" in s
+
+
+def test_analytic_param_count_matches_init():
+    from pyrecover_tpu.models import init_params
+    from pyrecover_tpu.models.presets import analytic_param_count
+
+    cfg = ModelConfig().tiny()
+    params = init_params(jax.random.key(0), cfg)
+    assert analytic_param_count(cfg) == get_num_params(params)
+
+
+def test_preset_8b_matches_reference_size():
+    """The llama-8b preset must land at the reference's ≈8.05B params
+    (SURVEY §2: dim 4096 × 32L, GQA 32/8, FFN 14336, vocab 131072)."""
+    from pyrecover_tpu.models.presets import analytic_param_count, llama_8b
+
+    n = analytic_param_count(llama_8b())
+    assert 7.9e9 < n < 8.2e9, n

@@ -1,0 +1,29 @@
+"""Profiling window smoke test: --profile must produce a trace via
+jax.profiler between the configured steps (reference NSYS window,
+train.py:236-239, 377-379 — here it's XProf/TensorBoard format)."""
+
+from pyrecover_tpu.config import TrainConfig
+from pyrecover_tpu.models import ModelConfig
+from pyrecover_tpu.train import train
+
+
+def test_profile_window_writes_trace(tmp_path):
+    cfg = TrainConfig(
+        sequence_length=32,
+        batch_size=8,
+        training_samples=64,
+        training_steps=6,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        checkpoint_frequency=-1,
+        experiment_name="prof",
+        logging_frequency=100,
+        profile=True,
+        profile_step_start=2,
+        profile_step_end=4,
+        profile_dir=str(tmp_path / "traces"),
+    )
+    cfg.model = ModelConfig().tiny(max_seq_len=32, vocab_size=128)
+    cfg.__post_init__()
+    train(cfg)
+    traces = list((tmp_path / "traces").rglob("*"))
+    assert any(p.is_file() for p in traces), "no profiler trace files written"
