@@ -21,7 +21,7 @@ Mesh axes:
 """
 
 import dataclasses
-import math
+import os
 
 import jax
 import numpy as np
@@ -117,8 +117,15 @@ def initialize_distributed(coordinator_address=None, num_processes=None, process
     args are accepted for non-TPU clusters (the SLURM-env analogue).
     No-op when running single-process.
     """
-    if jax.process_count() > 1:
-        return  # already initialized
+    # IMPORTANT: don't touch jax.devices()/process_count() here — that would
+    # initialize the local backend and make distributed init impossible.
+    try:
+        from jax._src import distributed as _dist
+
+        if getattr(_dist.global_state, "client", None) is not None:
+            return  # already initialized (e.g. by a launcher/test harness)
+    except Exception:
+        pass
     kwargs = {}
     if coordinator_address is not None:
         kwargs = dict(
@@ -126,11 +133,25 @@ def initialize_distributed(coordinator_address=None, num_processes=None, process
             num_processes=num_processes,
             process_id=process_id,
         )
+    else:
+        # auto-init only when a multi-host cluster is actually detectable:
+        # an explicit coordinator, or a TPU worker list naming >1 host.
+        # Anything else is a plain single-process run (the reference's
+        # maybe_init_distributed no-op path, dist_utils.py:60-68).
+        coord = os.environ.get("COORDINATOR_ADDRESS") or os.environ.get(
+            "JAX_COORDINATOR_ADDRESS"
+        )
+        workers = [
+            w for w in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if w
+        ]
+        if not coord and len(workers) <= 1:
+            return
     try:
         jax.distributed.initialize(**kwargs)
     except (ValueError, RuntimeError):
-        # Single-process run (no cluster env) — mirrors the reference's
-        # maybe_* behavior of silently running non-distributed.
+        # Cluster env looked present but init failed (e.g. single-host TPU
+        # VM) — run single-process, mirroring the reference's maybe_*
+        # tolerance.
         pass
 
 

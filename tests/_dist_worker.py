@@ -1,0 +1,111 @@
+"""Worker for the multi-process distributed test (launched by
+test_multiprocess.py, one instance per simulated host). Exercises the real
+multi-host paths: jax.distributed.initialize rendezvous, per-process batch
+slicing assembled into global arrays, host-0 broadcast, barriers, and
+checkpointing from a multi-process mesh."""
+
+import json
+import sys
+
+import jax
+
+import os
+
+
+def main():
+    proc_id = int(sys.argv[1])
+    num_procs = int(sys.argv[2])
+    port = sys.argv[3]
+    workdir = sys.argv[4]
+
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=num_procs,
+        process_id=proc_id,
+    )
+    assert jax.process_count() == num_procs
+
+    import numpy as np
+
+    from pyrecover_tpu.checkpoint import (
+        checkpoint_path,
+        load_ckpt_vanilla,
+        save_ckpt_vanilla,
+        load_ckpt_sharded,
+        save_ckpt_sharded,
+    )
+    from pyrecover_tpu.config import TrainConfig
+    from pyrecover_tpu.data import DataLoader, StatefulSampler, SyntheticTextDataset
+    from pyrecover_tpu.models import ModelConfig
+    from pyrecover_tpu.optim import build_optimizer
+    from pyrecover_tpu.parallel.mesh import (
+        MeshConfig,
+        broadcast_host0_scalar,
+        create_mesh,
+        sync_global_devices,
+    )
+    from pyrecover_tpu.train import init_sharded_state
+    from pyrecover_tpu.train_state import make_train_step
+
+    n_global = jax.device_count()
+    mesh = create_mesh(MeshConfig(data=n_global // 2, tensor=2))
+
+    model_cfg = ModelConfig(
+        dim=64, n_layers=2, n_heads=4, n_kv_heads=2, vocab_size=128,
+        multiple_of=32, max_seq_len=32,
+    )
+    cfg = TrainConfig(sequence_length=32, batch_size=8, training_samples=64,
+                      learning_rate=1e-3)
+    cfg.model = model_cfg
+    cfg.__post_init__()
+    model_cfg = cfg.model
+
+    optimizer, _ = build_optimizer(cfg)
+    state = init_sharded_state(jax.random.key(0), model_cfg, optimizer, mesh)
+
+    ds = SyntheticTextDataset(num_samples=64, seq_len=32, vocab_size=128, seed=7)
+    sampler = StatefulSampler(dataset_len=64, global_batch_size=8, seed=7)
+    loader = DataLoader(ds, sampler, pad_token_id=0, mesh=mesh, prefetch=0)
+    step_fn = make_train_step(model_cfg, optimizer, donate=False)
+
+    losses = []
+    with jax.sharding.set_mesh(mesh):
+        for _ in range(3):
+            _, batch = next(loader)
+            state, metrics = step_fn(state, batch)
+            losses.append(float(metrics["loss"]))
+
+    # host-0 decision broadcast (the stop-flag pattern)
+    flag = broadcast_host0_scalar(proc_id == 0 and 42 or 0)
+    assert flag == 42, f"broadcast gave {flag}"
+    sync_global_devices("worker_mid")
+
+    # vanilla checkpoint from a multi-process mesh (allgather of sharded
+    # leaves to host 0) then restore onto the mesh
+    vpath = checkpoint_path(workdir, "dist", 3)
+    save_ckpt_vanilla(vpath, state, {"consumed": 3}, verify=True)
+    state_v, sampler_meta, _ = load_ckpt_vanilla(vpath, state, verify=True)
+    assert sampler_meta["consumed"] == 3
+
+    # sharded checkpoint: every process writes its own shards
+    spath = checkpoint_path(workdir, "dist", 4, sharded=True)
+    save_ckpt_sharded(spath, state, {"consumed": 4}, extra_meta={"step": 4})
+    state_s, _, meta = load_ckpt_sharded(spath, state)
+    assert meta["step"] == 4
+
+    for a, b in zip(jax.tree_util.tree_leaves(state_v),
+                    jax.tree_util.tree_leaves(state_s)):
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b))
+        )
+
+    print("WORKER_RESULT " + json.dumps({
+        "proc": proc_id,
+        "devices": n_global,
+        "losses": losses,
+    }))
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()

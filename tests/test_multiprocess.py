@@ -1,0 +1,65 @@
+"""True multi-process distributed backend test: two OS processes, each with
+4 virtual CPU devices, rendezvous via jax.distributed into one 8-device
+mesh — the closest a single host gets to a real TPU pod (one process per
+host). Covers what the single-process suite cannot: cross-process
+collectives, per-process data slicing into global arrays, multihost
+barriers/broadcast, vanilla-save allgather, and Orbax multihost writes.
+
+(The reference's multi-node path was only ever testable on a live SLURM
+cluster — SURVEY §4.)"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+WORKER = Path(__file__).resolve().parent / "_dist_worker.py"
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_mesh(tmp_path):
+    port = str(free_port())
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("_PYRECOVER_TPU_TEST_ENV", None)
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(WORKER), str(i), "2", port, str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=REPO,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=420)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
+
+    results = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("WORKER_RESULT "):
+                r = json.loads(line[len("WORKER_RESULT "):])
+                results[r["proc"]] = r
+    assert set(results) == {0, 1}
+    assert results[0]["devices"] == 8
+    # both processes computed the same global losses (SPMD consistency)
+    np.testing.assert_allclose(results[0]["losses"], results[1]["losses"])
+    # and training actually progressed
+    assert results[0]["losses"][0] != results[0]["losses"][-1]
