@@ -98,12 +98,40 @@ def _sidecar(path):
     return p.with_suffix(p.suffix + ".sha256")
 
 
+class VanillaSaveHandle:
+    """Handle for a background vanilla save. ``wait()`` re-raises any write
+    error. Only the serialize/write half runs in the thread; everything
+    touching devices or collectives happened before the handle existed."""
+
+    def __init__(self, thread=None):
+        self._thread = thread
+        self.error = None
+
+    def wait(self):
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self.error is not None:
+            raise self.error
+
+    @property
+    def done(self):
+        return self._thread is None or not self._thread.is_alive()
+
+
 def save_ckpt_vanilla(path, state, sampler_state=None, *, verify=False,
-                      max_keep=None, extra_meta=None):
+                      max_keep=None, extra_meta=None, background=False):
     """Write the full training state to a single file (host 0 only).
 
-    Returns wall seconds spent (host 0; other hosts return barrier time) —
-    the save-timing signal the reference logs (train.py:332-340).
+    Returns wall seconds spent blocking the caller (host 0; other hosts
+    return barrier time) — the save-timing signal the reference logs
+    (train.py:332-340). With ``background=True`` returns
+    ``(blocking_seconds, VanillaSaveHandle)``: the device→host gather and
+    cross-host barrier stay on the calling thread (collectives must never
+    run concurrently), while serialization, file write, checksum, and
+    retention pruning — pure host-0-local work — overlap subsequent
+    training steps. The reference's vanilla save stalls every rank for the
+    full write (checkpoint.py:55-103); this one stalls only for the gather.
     """
     t0 = time.monotonic()
     path = Path(path)
@@ -111,49 +139,78 @@ def save_ckpt_vanilla(path, state, sampler_state=None, *, verify=False,
 
     path_leaves, treedef = jax.tree_util.tree_flatten_with_path(state)
     np_leaves = [_leaf_to_numpy(x) for _, x in path_leaves]  # allgather on ALL hosts
+    keystrs = [jax.tree_util.keystr(p) for p, _ in path_leaves]
+
+    if background:
+        handle = VanillaSaveHandle()
+        if jax.process_index() == 0:
+
+            def _bg():
+                try:
+                    _serialize_and_write(
+                        path, np_leaves, keystrs, str(treedef), sampler_state,
+                        extra_meta, verify, max_keep,
+                    )
+                except BaseException as e:  # surfaced at wait()
+                    handle.error = e
+
+            t = threading.Thread(target=_bg, daemon=True)
+            handle._thread = t
+            t.start()
+        # no exit barrier in background mode: the remaining work is
+        # host-0-local, so other hosts have nothing to wait for
+        return time.monotonic() - t0, handle
 
     if jax.process_index() == 0:
-        path.parent.mkdir(parents=True, exist_ok=True)
-        meta = {
-            "format": FORMAT_VERSION,
-            "num_leaves": len(np_leaves),
-            "treedef": str(treedef),
-            # leaf key-paths, for the equality CLI and cross-format comparison
-            "paths": [jax.tree_util.keystr(p) for p, _ in path_leaves],
-            "sampler": sampler_state or {},
-        }
-        if extra_meta:
-            meta.update(extra_meta)
-        payload = msgpack_serialize(
-            {
-                "meta": json.dumps(meta),
-                "leaves": {str(i): leaf for i, leaf in enumerate(np_leaves)},
-            }
+        _serialize_and_write(
+            path, np_leaves, keystrs, str(treedef), sampler_state, extra_meta,
+            verify, max_keep,
         )
-        from pyrecover_tpu.checkpoint import native_io
-
-        fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=path.name, suffix=".tmp")
-        checksum = None
-        try:
-            if native_io.available():
-                # parallel pwrite + checksum computed in the same pass
-                os.close(fd)
-                digest = native_io.write_file(tmp, payload, chunk=_HASH_CHUNK)
-                checksum = f"xxh64tree:{_HASH_CHUNK}:{digest:016x}"
-            else:
-                with os.fdopen(fd, "wb") as f:
-                    f.write(payload)
-            os.replace(tmp, path)  # atomic publish
-        finally:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-        if verify:
-            _sidecar(path).write_text(checksum or compute_checksum(path))
-        if max_keep:
-            prune_checkpoints(path.parent, max_keep, sharded=False)
 
     sync_global_devices("vanilla_save_exit")
     return time.monotonic() - t0
+
+
+def _serialize_and_write(path, np_leaves, keystrs, treedef_str, sampler_state,
+                         extra_meta, verify, max_keep):
+    path.parent.mkdir(parents=True, exist_ok=True)
+    meta = {
+        "format": FORMAT_VERSION,
+        "num_leaves": len(np_leaves),
+        "treedef": treedef_str,
+        # leaf key-paths, for the equality CLI and cross-format comparison
+        "paths": keystrs,
+        "sampler": sampler_state or {},
+    }
+    if extra_meta:
+        meta.update(extra_meta)
+    payload = msgpack_serialize(
+        {
+            "meta": json.dumps(meta),
+            "leaves": {str(i): leaf for i, leaf in enumerate(np_leaves)},
+        }
+    )
+    from pyrecover_tpu.checkpoint import native_io
+
+    fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=path.name, suffix=".tmp")
+    checksum = None
+    try:
+        if native_io.available():
+            # parallel pwrite + checksum computed in the same pass
+            os.close(fd)
+            digest = native_io.write_file(tmp, payload, chunk=_HASH_CHUNK)
+            checksum = f"xxh64tree:{_HASH_CHUNK}:{digest:016x}"
+        else:
+            with os.fdopen(fd, "wb") as f:
+                f.write(payload)
+        os.replace(tmp, path)  # atomic publish
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    if verify:
+        _sidecar(path).write_text(checksum or compute_checksum(path))
+    if max_keep:
+        prune_checkpoints(path.parent, max_keep, sharded=False)
 
 
 def load_ckpt_vanilla(path, target_state, *, verify=False):

@@ -133,6 +133,12 @@ def train(config: TrainConfig):
     )
 
     # ---- checkpoint strategy dispatch (reference train.py:153-161) ---------
+    pending_vanilla = []  # at most one in-flight background vanilla save
+
+    def join_pending_saves():
+        while pending_vanilla:
+            pending_vanilla.pop().wait()
+
     def save_ckpt(step, final=False):
         path = checkpoint_path(
             config.checkpoint_dir, config.experiment_name, step,
@@ -151,11 +157,21 @@ def train(config: TrainConfig):
             if final:
                 sharded_ckptr.wait()
         else:
-            secs = save_ckpt_vanilla(
-                path, state_to_save, sampler_meta,
-                verify=config.verify_checkpoints,
-                max_keep=config.max_kept_checkpoints, extra_meta=extra,
-            )
+            join_pending_saves()  # serialize with any in-flight write
+            if config.async_checkpoint and not final:
+                secs, handle = save_ckpt_vanilla(
+                    path, state_to_save, sampler_meta,
+                    verify=config.verify_checkpoints,
+                    max_keep=config.max_kept_checkpoints, extra_meta=extra,
+                    background=True,
+                )
+                pending_vanilla.append(handle)
+            else:
+                secs = save_ckpt_vanilla(
+                    path, state_to_save, sampler_meta,
+                    verify=config.verify_checkpoints,
+                    max_keep=config.max_kept_checkpoints, extra_meta=extra,
+                )
         log_host0("Saved checkpoint %s in %.2f s", path.name, secs)
         return secs
 
@@ -267,6 +283,7 @@ def train(config: TrainConfig):
 
     loader.stop()
     csv_logger.close()
+    join_pending_saves()
     if sharded_ckptr is not None:
         sharded_ckptr.close()
     write_requeue_marker(exp_dir, done=not stopped_early)

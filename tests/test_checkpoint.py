@@ -147,3 +147,28 @@ def test_sharded_restore_onto_mesh(tmp_ckpt_dir, devices8):
     for a, b in zip(jax.tree_util.tree_leaves(state.params),
                     jax.tree_util.tree_leaves(restored.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_vanilla_background_save(tmp_ckpt_dir):
+    """Background save: returns quickly with a handle; after wait() the file
+    is complete, verified, and loadable; write errors surface at wait()."""
+    from pyrecover_tpu.checkpoint.vanilla import save_ckpt_vanilla as save
+
+    state = make_state(seed=6)
+    path = checkpoint_path(tmp_ckpt_dir, "bg", 1)
+    secs, handle = save(path, state, {"consumed": 1}, verify=True,
+                        background=True)
+    handle.wait()
+    assert handle.done
+    target = make_state(seed=7)
+    restored, sampler_state, _ = load_ckpt_vanilla(path, target, verify=True)
+    assert sampler_state["consumed"] == 1
+    for a, b in zip(jax.tree_util.tree_leaves(state),
+                    jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # unwritable destination → error surfaces at wait(), not silently lost
+    bad = checkpoint_path("/proc/definitely-not-writable", "bg", 2)
+    _, bad_handle = save(bad, state, background=True)
+    with pytest.raises(BaseException):
+        bad_handle.wait()

@@ -43,20 +43,27 @@ def leaves(state):
     return [np.asarray(x) for x in jax.tree_util.tree_leaves(state)]
 
 
-@pytest.mark.parametrize("sharded", [False, True])
-def test_driver_resume_bitexact(tmp_path, sharded):
+@pytest.mark.parametrize(
+    "sharded,async_ckpt",
+    [(False, False), (True, False), (False, True), (True, True)],
+    ids=["vanilla", "sharded", "vanilla-async", "sharded-async"],
+)
+def test_driver_resume_bitexact(tmp_path, sharded, async_ckpt):
     straight_dir = tmp_path / "straight"
     resumed_dir = tmp_path / "resumed"
 
-    cfg = tiny_config(straight_dir, sharded_checkpoint=sharded)
+    cfg = tiny_config(straight_dir, sharded_checkpoint=sharded,
+                      async_checkpoint=async_ckpt)
     straight_state, _, _ = train(cfg)
 
     # interrupted: run only 4 steps
-    cfg1 = tiny_config(resumed_dir, training_steps=4, sharded_checkpoint=sharded)
+    cfg1 = tiny_config(resumed_dir, training_steps=4, sharded_checkpoint=sharded,
+                       async_checkpoint=async_ckpt)
     train(cfg1)
     # resumed: same total steps, restore from latest
     cfg2 = tiny_config(
-        resumed_dir, sharded_checkpoint=sharded, resume_from_checkpoint="latest"
+        resumed_dir, sharded_checkpoint=sharded, async_checkpoint=async_ckpt,
+        resume_from_checkpoint="latest",
     )
     resumed_state, end_step, stopped = train(cfg2)
 
