@@ -1,0 +1,83 @@
+"""Config/CLI surface tests: a reference-style command line (the flag
+vocabulary of utils.py:105-261 / submit-training-simple.sh) must parse into
+the right TrainConfig."""
+
+import pytest
+
+from pyrecover_tpu.config import get_args
+
+
+def test_reference_style_command_line():
+    cfg = get_args([
+        "--dataset", "/data/train.parquet",
+        "--tokenizer-name-or-path", "unsloth/Mistral-Nemo-Base-2407-bnb-4bit",
+        "--sequence-length", "2048",
+        "--batch-size", "32",
+        "--learning-rate", "1e-5",
+        "--lr-warmup-steps", "10",
+        "--training-steps", "3000",
+        "--logging-frequency", "10",
+        "--checkpoint-dir", "checkpoints/",
+        "--checkpoint-frequency", "1000",
+        "--experiment_name", "my-exp",
+        "--verify-checkpoints",
+        "--max-kept-checkpoints", "3",
+        "--use-torch-distributed-ckpt",
+        "--timeaware-checkpointing",
+        "--default-iter-time", "1.0",
+        "--default-ckpt-time", "10.0",
+        "--use_flash_attention",
+        "--log-loss-to-csv",
+        "--fused-optimizer",
+        "--compile",
+        "--distributed",
+        "--model-dtype", "bf16",
+        "--grad-max-norm", "1",
+        "--profile", "--profile-step-start", "10", "--profile-step-end", "12",
+        "--resume-from-checkpoint", "latest",
+    ])
+    assert cfg.dataset == "/data/train.parquet"
+    assert cfg.sequence_length == 2048
+    assert cfg.model.max_seq_len == 2048
+    assert cfg.batch_size == 32
+    assert cfg.training_steps == 3000
+    assert cfg.experiment_name == "my-exp"
+    assert cfg.verify_checkpoints
+    assert cfg.sharded_checkpoint  # --use-torch-distributed-ckpt alias
+    assert cfg.timeaware_checkpointing
+    assert cfg.model.attention_impl == "flash"  # --use_flash_attention
+    assert cfg.log_loss_to_csv
+    assert cfg.resume_from_checkpoint == "latest"
+    assert cfg.model.compute_dtype == "bfloat16"
+    assert cfg.grad_max_norm == 1.0
+    assert cfg.profile and cfg.profile_step_start == 10
+
+
+def test_mesh_flags():
+    cfg = get_args(["--dp", "2", "--fsdp", "2", "--tp", "2", "--sp", "1"])
+    assert (cfg.mesh.data, cfg.mesh.fsdp, cfg.mesh.tensor, cfg.mesh.sequence) == (
+        2, 2, 2, 1
+    )
+
+
+def test_defaults_mirror_reference():
+    cfg = get_args([])
+    # reference defaults: seq 2048, batch 1 (global), lr 1e-5, warmup 10,
+    # ckpt freq 10, max kept 3, experiment 'default-exp' (utils.py:105-261)
+    assert cfg.sequence_length == 2048
+    assert cfg.batch_size == 1
+    assert cfg.learning_rate == 1e-5
+    assert cfg.lr_warmup_steps == 10
+    assert cfg.checkpoint_frequency == 10
+    assert cfg.max_kept_checkpoints == 3
+    assert cfg.experiment_name == "default-exp"
+    # 8B reference model shape (train.py:88-99)
+    assert cfg.model.dim == 4096 and cfg.model.n_layers == 32
+    assert cfg.model.n_heads == 32 and cfg.model.n_kv_heads == 8
+    # grad clipping ON here (the reference comments out its call site)
+    assert cfg.grad_clipping
+
+
+def test_checkpoint_frequency_disable():
+    cfg = get_args(["--checkpoint-frequency", "-1"])
+    assert cfg.checkpoint_frequency == -1
