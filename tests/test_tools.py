@@ -1,0 +1,89 @@
+"""Tools tests: checkpoint inspector and loss-convergence comparator —
+including the reference's signature workflow: interrupted+resumed run's loss
+CSV must match the straight run's exactly on the post-resume range."""
+
+import sys
+from pathlib import Path
+
+import jax
+
+sys.path.insert(0, "tools")
+from compare_loss_csv import main as compare_main  # noqa: E402
+from inspect_checkpoint import main as inspect_main  # noqa: E402
+
+from pyrecover_tpu.checkpoint import checkpoint_path, save_ckpt_sharded, save_ckpt_vanilla
+from pyrecover_tpu.config import TrainConfig
+from pyrecover_tpu.models import ModelConfig
+from pyrecover_tpu.optim import build_optimizer
+from pyrecover_tpu.train import train
+from pyrecover_tpu.train_state import create_train_state
+
+
+def make_state():
+    optimizer, _ = build_optimizer(TrainConfig(sequence_length=16))
+    return create_train_state(
+        jax.random.key(0), ModelConfig().tiny(max_seq_len=16), optimizer
+    )
+
+
+def test_inspect_both_formats(tmp_path, capsys):
+    state = make_state()
+    v = checkpoint_path(tmp_path, "x", 1)
+    save_ckpt_vanilla(v, state, {"consumed": 1}, extra_meta={"step": 1})
+    assert inspect_main([str(v), "--leaves"]) == 0
+    out = capsys.readouterr().out
+    assert "vanilla" in out and "step: 1" in out and "tok_embed" in out
+
+    d = checkpoint_path(tmp_path, "x", 2, sharded=True)
+    save_ckpt_sharded(d, state, extra_meta={"step": 2})
+    assert inspect_main([str(d)]) == 0
+    out = capsys.readouterr().out
+    assert "sharded" in out and "step: 2" in out
+
+    assert inspect_main([str(tmp_path / "nope")]) == 2
+
+
+def write_csv(path, rows):
+    path.write_text("step,loss\n" + "\n".join(f"{s},{l}" for s, l in rows) + "\n")
+
+
+def test_compare_loss_csv(tmp_path, capsys):
+    a = tmp_path / "a.csv"
+    b = tmp_path / "b.csv"
+    write_csv(a, [(1, 4.0), (2, 3.5), (3, 3.2)])
+    write_csv(b, [(2, 3.5), (3, 3.2), (4, 3.0)])
+    assert compare_main([str(a), str(b)]) == 0
+    write_csv(b, [(2, 3.5), (3, 3.9)])
+    assert compare_main([str(a), str(b)]) == 1
+    assert compare_main([str(a), str(b), "--tolerance", "1.0"]) == 0
+    assert compare_main([str(a), str(tmp_path / "missing.csv")]) == 2
+
+
+def test_resume_loss_curve_matches_straight(tmp_path):
+    """The reference's loss-convergence benchmark, end to end: per-step loss
+    of interrupted+resumed == straight run, bit-exact, on the resumed range."""
+
+    def cfg(d, steps, resume=None):
+        c = TrainConfig(
+            sequence_length=32, batch_size=8, training_samples=64,
+            training_steps=steps, learning_rate=1e-3, seed=3,
+            checkpoint_dir=str(d), checkpoint_frequency=3,
+            experiment_name="exp", logging_frequency=100,
+            log_loss_to_csv=True, resume_from_checkpoint=resume,
+            async_checkpoint=False,
+        )
+        c.model = ModelConfig().tiny(max_seq_len=32, vocab_size=128)
+        c.__post_init__()
+        return c
+
+    d1, d2 = tmp_path / "straight", tmp_path / "resumed"
+    train(cfg(d1, 6))
+    train(cfg(d2, 3))
+    csv_first = (d2 / "exp" / "exp_loss_log.csv").read_text()
+    train(cfg(d2, 6, resume="latest"))
+
+    a = d1 / "exp" / "exp_loss_log.csv"
+    b = d2 / "exp" / "exp_loss_log.csv"
+    # the resumed run overwrote the CSV with steps 4-6; compare that range
+    assert compare_main([str(a), str(b), "--tolerance", "0", "--from-step", "4"]) == 0
+    assert "1,," not in csv_first  # sanity: first run logged steps 1-3

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Loss-convergence comparison of two runs from their per-step loss CSVs.
+
+This automates the reference's second documented benchmark procedure
+(README.md:231-235: overlay `--log-loss-to-csv` curves of an interrupted+
+resumed run against a straight run). Exit codes: 0 = curves agree within
+--tolerance on the overlapping step range, 1 = diverged, 2 = error.
+
+Usage:
+  python tools/compare_loss_csv.py A_loss_log.csv B_loss_log.csv \
+      [--tolerance 1e-6] [--from-step N]
+"""
+
+import argparse
+import csv
+import sys
+
+
+def read_csv(path):
+    out = {}
+    with open(path) as f:
+        for row in csv.DictReader(f):
+            out[int(row["step"])] = float(row["loss"])
+    if not out:
+        raise ValueError(f"{path} has no rows")
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("csv_a")
+    ap.add_argument("csv_b")
+    ap.add_argument("--tolerance", type=float, default=1e-6,
+                    help="max |loss_a - loss_b| per overlapping step")
+    ap.add_argument("--from-step", type=int, default=0)
+    args = ap.parse_args(argv)
+    try:
+        a = read_csv(args.csv_a)
+        b = read_csv(args.csv_b)
+    except Exception as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 2
+
+    common = sorted(s for s in set(a) & set(b) if s >= args.from_step)
+    if not common:
+        print("ERROR: no overlapping steps", file=sys.stderr)
+        return 2
+
+    worst_step, worst = None, 0.0
+    bad = 0
+    for s in common:
+        d = abs(a[s] - b[s])
+        if d > worst:
+            worst, worst_step = d, s
+        if d > args.tolerance:
+            bad += 1
+    print(
+        f"{len(common)} overlapping steps | worst |Δloss| {worst:.3e} at "
+        f"step {worst_step} | {bad} step(s) beyond tolerance {args.tolerance:g}"
+    )
+    if bad:
+        print("DIVERGED")
+        return 1
+    print("CONVERGENCE MATCH")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Inspect a checkpoint (either format): step/epoch metadata, sampler
+data-order state, leaf count/shapes/dtypes/bytes.
+
+Usage: python tools/inspect_checkpoint.py PATH [--leaves]
+"""
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def human(n):
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if n < 1024:
+            return f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}PB"
+
+
+def inspect_vanilla(path, show_leaves):
+    from flax.serialization import msgpack_restore
+
+    raw = msgpack_restore(Path(path).read_bytes())
+    meta = json.loads(raw["meta"])
+    print(f"format: vanilla single-file (v{meta['format']})")
+    for k in ("step", "epoch"):
+        if k in meta:
+            print(f"{k}: {meta[k]}")
+    if meta.get("sampler"):
+        print(f"sampler state: {meta['sampler']}")
+    leaves = [raw["leaves"][str(i)] for i in range(meta["num_leaves"])]
+    paths = meta.get("paths", [f"leaf{i}" for i in range(len(leaves))])
+    total = sum(x.nbytes for x in leaves)
+    print(f"leaves: {len(leaves)} | total {human(total)}")
+    if show_leaves:
+        for p, x in zip(paths, leaves):
+            print(f"  {p}: {x.dtype} {tuple(x.shape)} {human(x.nbytes)}")
+
+
+def inspect_sharded(path, show_leaves):
+    import orbax.checkpoint as ocp
+
+    path = Path(path).absolute()
+    print("format: sharded (Orbax/tensorstore) directory")
+    try:
+        meta = ocp.Checkpointer(ocp.JsonCheckpointHandler()).restore(path / "meta")
+        for k in ("step", "epoch"):
+            if k in meta:
+                print(f"{k}: {meta[k]}")
+        if meta.get("sampler"):
+            print(f"sampler state: {meta['sampler']}")
+    except Exception:
+        pass
+    with ocp.PyTreeCheckpointer() as ckptr:
+        import jax
+
+        tree = ckptr.metadata(path / "state")
+        flat = jax.tree_util.tree_flatten_with_path(
+            tree.tree if hasattr(tree, "tree") else tree
+        )[0]
+        total = 0
+        rows = []
+        for keypath, leaf in flat:
+            shape = tuple(getattr(leaf, "shape", ()) or ())
+            dtype = getattr(leaf, "dtype", "?")
+            nbytes = 1
+            for s in shape:
+                nbytes *= s
+            try:
+                import numpy as np
+
+                nbytes *= np.dtype(dtype).itemsize
+            except Exception:
+                nbytes = 0
+            total += nbytes
+            rows.append((jax.tree_util.keystr(keypath), dtype, shape, nbytes))
+        print(f"leaves: {len(rows)} | total {human(total)}")
+        if show_leaves:
+            for name, dtype, shape, nbytes in rows:
+                print(f"  {name}: {dtype} {shape} {human(nbytes)}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("checkpoint")
+    ap.add_argument("--leaves", action="store_true", help="list every leaf")
+    args = ap.parse_args(argv)
+    p = Path(args.checkpoint)
+    if not p.exists():
+        print(f"ERROR: {p} does not exist", file=sys.stderr)
+        return 2
+    if p.is_dir():
+        inspect_sharded(p, args.leaves)
+    else:
+        inspect_vanilla(p, args.leaves)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
