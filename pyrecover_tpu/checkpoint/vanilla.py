@@ -213,6 +213,31 @@ def _serialize_and_write(path, np_leaves, keystrs, treedef_str, sampler_state,
         prune_checkpoints(path.parent, max_keep, sharded=False)
 
 
+def read_ckpt_raw(path, *, check_version=True):
+    """Read a vanilla checkpoint without a target state: returns
+    ``(meta, paths, leaves)`` where ``paths`` are leaf key-path strings and
+    ``leaves`` are numpy arrays in tree-flatten order. The single decoder of
+    the on-disk layout — the equality CLI and the inspector build on it.
+
+    ``check_version=False`` lets diagnostic tools display/compare
+    checkpoints from other format versions on a best-effort basis instead
+    of refusing them; the restore path must keep the check."""
+    from pyrecover_tpu.checkpoint import native_io
+
+    path = Path(path)
+    if native_io.available():
+        data, _ = native_io.read_file(path)  # parallel pread
+    else:
+        data = path.read_bytes()
+    raw = msgpack_restore(data)
+    meta = json.loads(raw["meta"])
+    if check_version and meta["format"] != FORMAT_VERSION:
+        raise ValueError(f"Unsupported checkpoint format {meta['format']}")
+    leaves = [raw["leaves"][str(i)] for i in range(meta["num_leaves"])]
+    paths = meta.get("paths") or [f"leaf{i}" for i in range(len(leaves))]
+    return meta, paths, leaves
+
+
 def load_ckpt_vanilla(path, target_state, *, verify=False):
     """Restore a checkpoint into the structure/shardings of ``target_state``.
 
@@ -246,23 +271,13 @@ def load_ckpt_vanilla(path, target_state, *, verify=False):
         verify_thread = threading.Thread(target=_verify, daemon=True)
         verify_thread.start()
 
-    from pyrecover_tpu.checkpoint import native_io
-
-    if native_io.available():
-        data, _ = native_io.read_file(path)  # parallel pread
-    else:
-        data = path.read_bytes()
-    raw = msgpack_restore(data)
-    meta = json.loads(raw["meta"])
-    if meta["format"] != FORMAT_VERSION:
-        raise ValueError(f"Unsupported checkpoint format {meta['format']}")
+    meta, _, np_leaves = read_ckpt_raw(path)
 
     leaves, treedef = jax.tree_util.tree_flatten(target_state)
     if meta["num_leaves"] != len(leaves):
         raise ValueError(
             f"Checkpoint has {meta['num_leaves']} leaves, target expects {len(leaves)}"
         )
-    np_leaves = [raw["leaves"][str(i)] for i in range(len(leaves))]
 
     restored = []
     for tgt, src in zip(leaves, np_leaves):

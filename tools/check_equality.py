@@ -21,12 +21,13 @@ training-state equality.
 """
 
 import argparse
-import json
 import re
 import sys
 from pathlib import Path
 
 import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
 def _norm_key(keystr):
@@ -40,14 +41,9 @@ def _norm_key(keystr):
 
 
 def load_vanilla(path):
-    from flax.serialization import msgpack_restore
+    from pyrecover_tpu.checkpoint.vanilla import read_ckpt_raw
 
-    raw = msgpack_restore(Path(path).read_bytes())
-    meta = json.loads(raw["meta"])
-    paths = meta.get("paths")
-    leaves = [raw["leaves"][str(i)] for i in range(meta["num_leaves"])]
-    if paths is None:
-        paths = [f"leaf{i}" for i in range(len(leaves))]
+    _, paths, leaves = read_ckpt_raw(path, check_version=False)
     return {_norm_key(p): np.asarray(v) for p, v in zip(paths, leaves)}
 
 

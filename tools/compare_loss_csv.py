@@ -13,6 +13,7 @@ Usage:
 
 import argparse
 import csv
+import math
 import sys
 
 
@@ -46,10 +47,16 @@ def main(argv=None):
         print("ERROR: no overlapping steps", file=sys.stderr)
         return 2
 
-    worst_step, worst = None, 0.0
+    worst_step, worst = common[0], 0.0
     bad = 0
     for s in common:
         d = abs(a[s] - b[s])
+        # a non-finite delta (NaN/inf loss in either run) is a divergence,
+        # not a match — NaN compares False against any tolerance
+        if not math.isfinite(d):
+            worst, worst_step = d, s
+            bad += 1
+            continue
         if d > worst:
             worst, worst_step = d, s
         if d > args.tolerance:

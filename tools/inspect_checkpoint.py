@@ -6,9 +6,10 @@ Usage: python tools/inspect_checkpoint.py PATH [--leaves]
 """
 
 import argparse
-import json
 import sys
 from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
 def human(n):
@@ -20,18 +21,15 @@ def human(n):
 
 
 def inspect_vanilla(path, show_leaves):
-    from flax.serialization import msgpack_restore
+    from pyrecover_tpu.checkpoint.vanilla import read_ckpt_raw
 
-    raw = msgpack_restore(Path(path).read_bytes())
-    meta = json.loads(raw["meta"])
+    meta, paths, leaves = read_ckpt_raw(path, check_version=False)
     print(f"format: vanilla single-file (v{meta['format']})")
     for k in ("step", "epoch"):
         if k in meta:
             print(f"{k}: {meta[k]}")
     if meta.get("sampler"):
         print(f"sampler state: {meta['sampler']}")
-    leaves = [raw["leaves"][str(i)] for i in range(meta["num_leaves"])]
-    paths = meta.get("paths", [f"leaf{i}" for i in range(len(leaves))])
     total = sum(x.nbytes for x in leaves)
     print(f"leaves: {len(leaves)} | total {human(total)}")
     if show_leaves:
@@ -51,8 +49,8 @@ def inspect_sharded(path, show_leaves):
                 print(f"{k}: {meta[k]}")
         if meta.get("sampler"):
             print(f"sampler state: {meta['sampler']}")
-    except Exception:
-        pass
+    except Exception as e:
+        print(f"warning: meta unreadable: {e}", file=sys.stderr)
     with ocp.PyTreeCheckpointer() as ckptr:
         import jax
 
@@ -64,16 +62,15 @@ def inspect_sharded(path, show_leaves):
         rows = []
         for keypath, leaf in flat:
             shape = tuple(getattr(leaf, "shape", ()) or ())
-            dtype = getattr(leaf, "dtype", "?")
-            nbytes = 1
-            for s in shape:
-                nbytes *= s
+            dtype = getattr(leaf, "dtype", None)
             try:
                 import numpy as np
 
-                nbytes *= np.dtype(dtype).itemsize
+                nbytes = np.dtype(dtype).itemsize
+                for s in shape:
+                    nbytes *= s
             except Exception:
-                nbytes = 0
+                dtype, nbytes = "?", 0
             total += nbytes
             rows.append((jax.tree_util.keystr(keypath), dtype, shape, nbytes))
         print(f"leaves: {len(rows)} | total {human(total)}")
