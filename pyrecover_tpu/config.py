@@ -46,6 +46,7 @@ class TrainConfig:
     param_dtype: str = "fp32"  # master weights; TPU-native improvement over all-bf16
     use_flash_attention: bool = False
     remat: bool = False
+    pp_microbatches: int = 0  # pipeline microbatches; 0 → stage count
     loss_chunk_size: int = 0  # >0: fused chunked CE, never materializes full logits
     # -- parallelism ---------------------------------------------------------
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
@@ -82,6 +83,7 @@ class TrainConfig:
                          "fp64": "float64"}.get(self.param_dtype, self.param_dtype),
             attention_impl="flash" if self.use_flash_attention else self.model.attention_impl,
             remat=self.remat or self.model.remat,
+            pp_microbatches=self.pp_microbatches or self.model.pp_microbatches,
         )
 
 
@@ -138,6 +140,10 @@ def build_parser():
     p.add_argument("--fsdp", type=int, default=d.mesh.fsdp)
     p.add_argument("--tp", type=int, default=d.mesh.tensor)
     p.add_argument("--sp", type=int, default=d.mesh.sequence)
+    p.add_argument("--pp", type=int, default=d.mesh.pipeline,
+                   help="pipeline-parallel stages (layers sharded across stages)")
+    p.add_argument("--pp-microbatches", type=int, default=d.pp_microbatches,
+                   help="pipeline microbatch count; 0 = number of stages")
 
     # checkpointing (utils.py:190-232)
     p.add_argument("--checkpoint-dir", type=str, default=d.checkpoint_dir)
@@ -198,7 +204,9 @@ def get_args(argv=None):
         use_flash_attention=ns.use_flash_attention,
         remat=ns.remat,
         loss_chunk_size=ns.loss_chunk_size,
-        mesh=MeshConfig(data=ns.dp, fsdp=ns.fsdp, tensor=ns.tp, sequence=ns.sp),
+        mesh=MeshConfig(data=ns.dp, fsdp=ns.fsdp, tensor=ns.tp, sequence=ns.sp,
+                        pipeline=ns.pp),
+        pp_microbatches=ns.pp_microbatches,
         distributed=ns.distributed,
         checkpoint_dir=ns.checkpoint_dir,
         checkpoint_frequency=ns.checkpoint_frequency,

@@ -54,6 +54,7 @@ class ModelConfig:
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
     attention_impl: str = "sdpa"  # "sdpa" | "flash" | "ring"
+    pp_microbatches: int = 0  # pipeline microbatch count; 0 → stage count
     remat: bool = False
     flash_block_q: int = 512
     flash_block_kv: int = 512
@@ -209,10 +210,14 @@ def forward_hidden(params, tokens, config):
             block, policy=jax.checkpoint_policies.nothing_saveable
         )
 
-    def scan_body(x, layer):
-        return block(x, layer), None
+    # Under a mesh with a pipeline axis >1 this runs the microbatched
+    # ppermute schedule (stages hold layer slices); otherwise it reduces to
+    # a plain lax.scan over the stacked layers.
+    from pyrecover_tpu.parallel.pipeline import pipeline_blocks
 
-    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = pipeline_blocks(
+        params["layers"], x, block, n_microbatches=cfg.pp_microbatches
+    )
 
     return rms_norm(x, params["final_norm"], cfg.norm_eps)
 

@@ -18,6 +18,12 @@ Mesh axes:
                    hidden, collectives ride ICI.
   * ``sequence`` — sequence/context parallelism for long sequences (ring
                    attention over this axis).
+  * ``pipeline`` — pipeline parallelism over transformer layers: the stacked
+                   layer pytree is sharded on its leading (layer) axis, and
+                   microbatch activations rotate stage→stage via
+                   ``ppermute`` inside a ``shard_map`` schedule
+                   (`parallel.pipeline`). The reference has no PP
+                   (SURVEY §2.2).
 """
 
 import dataclasses
@@ -31,35 +37,43 @@ AXIS_DATA = "data"
 AXIS_FSDP = "fsdp"
 AXIS_TENSOR = "tensor"
 AXIS_SEQ = "sequence"
+AXIS_PIPE = "pipeline"
 
-MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_TENSOR, AXIS_SEQ)
+MESH_AXES = (AXIS_PIPE, AXIS_DATA, AXIS_FSDP, AXIS_TENSOR, AXIS_SEQ)
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
-    """Logical mesh shape. ``data=-1`` means "all remaining devices"."""
+    """Logical mesh shape. ``data=-1`` means "all remaining devices".
+
+    The pipeline axis is outermost in device order: stage boundaries are the
+    lowest-bandwidth cut (only activations cross them, once per microbatch
+    tick), so they should land on the outermost/slowest links.
+    """
 
     data: int = -1
     fsdp: int = 1
     tensor: int = 1
     sequence: int = 1
+    pipeline: int = 1
 
     def resolve(self, n_devices):
-        fixed = self.fsdp * self.tensor * self.sequence
+        fixed = self.fsdp * self.tensor * self.sequence * self.pipeline
         data = self.data
         if data == -1:
             if n_devices % fixed != 0:
                 raise ValueError(
-                    f"{n_devices} devices not divisible by fsdp*tensor*sequence={fixed}"
+                    f"{n_devices} devices not divisible by "
+                    f"pipeline*fsdp*tensor*sequence={fixed}"
                 )
             data = n_devices // fixed
         total = data * fixed
         if total != n_devices:
             raise ValueError(
-                f"Mesh {data}x{self.fsdp}x{self.tensor}x{self.sequence}={total} "
-                f"!= available devices {n_devices}"
+                f"Mesh {self.pipeline}x{data}x{self.fsdp}x{self.tensor}"
+                f"x{self.sequence}={total} != available devices {n_devices}"
             )
-        return (data, self.fsdp, self.tensor, self.sequence)
+        return (self.pipeline, data, self.fsdp, self.tensor, self.sequence)
 
 
 def create_mesh(config=None, devices=None):

@@ -1,0 +1,139 @@
+"""Pipeline parallelism: a GPipe-style microbatched schedule over the
+stacked-layer pytree, expressed as pure SPMD collectives.
+
+The reference has no pipeline parallelism (SURVEY §2.2 — DP via DDP is its
+only strategy). This is the TPU-native construction: rather than a stage
+*scheduler* (the GPU-framework pattern — per-stage processes, P2P sends,
+explicit 1F1B event loops), the whole pipeline is ONE jitted SPMD program:
+
+  * The layer-stacked parameter pytree (leaves shaped ``(L, ...)``) is
+    sharded on its leading axis over the ``pipeline`` mesh axis — each stage
+    physically holds ``L/S`` contiguous layers.
+  * Inside ``jax.shard_map`` (manual over ``pipeline`` only — data/fsdp/
+    tensor/sequence shardings of the *other* dims remain compiler-managed),
+    every stage runs the same tick loop: take a microbatch activation, run
+    the local layer slice, hand the result to the next stage with
+    ``lax.ppermute``. After ``M + S - 1`` ticks all ``M`` microbatches have
+    drained through all ``S`` stages.
+  * The backward schedule is DERIVED, not written: ``jax.grad`` through the
+    ``scan``+``ppermute`` forward transposes the permute (activations flow
+    stage ``s+1 → s``) and reverses the scan — a reverse-order pipeline with
+    exactly GPipe's dataflow.
+
+Bubble fraction is the textbook ``(S-1)/(M+S-1)``; raise ``n_microbatches``
+to amortize. Peak activation memory per stage is ``M/S`` of the full batch's
+(all microbatches are in flight, GPipe-style); combine with block remat
+(``ModelConfig.remat``) for long sequences.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from pyrecover_tpu.parallel.mesh import AXIS_PIPE
+
+
+def pipeline_axis_size():
+    """Size of the pipeline axis of the context mesh (1 = PP disabled)."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return 1
+    return mesh.shape.get(AXIS_PIPE, 1)
+
+
+def _pvary(x):
+    return jax.lax.pcast(x, (AXIS_PIPE,), to="varying")
+
+
+def pipeline_blocks(layer_params, x, block_fn, n_microbatches=0):
+    """Run ``x`` through the full layer stack across pipeline stages.
+
+    Args:
+      layer_params: pytree with leaves stacked ``(L, ...)``, sharded on the
+        leading axis over the ``pipeline`` mesh axis.
+      x: activations ``(batch, seq, dim)``; batch must be divisible by the
+        microbatch count.
+      block_fn: ``(x_mb, layer_slice) -> x_mb`` — one transformer block on
+        one microbatch (already remat-wrapped by the caller if desired).
+      n_microbatches: microbatch count ``M``; 0 → the stage count.
+
+    Returns activations ``(batch, seq, dim)`` after all L layers.
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    n_stages = pipeline_axis_size()
+    if n_stages <= 1:
+        # no pipeline axis in the mesh: plain scan over the full stack
+        def body(c, layer):
+            return block_fn(c, layer), None
+
+        out, _ = jax.lax.scan(body, x, layer_params)
+        return out
+
+    M = int(n_microbatches) if n_microbatches else n_stages
+    S = n_stages
+    b = x.shape[0]
+    if b % M:
+        raise ValueError(f"batch {b} not divisible by {M} microbatches")
+    n_layers = jax.tree_util.tree_leaves(layer_params)[0].shape[0]
+    if n_layers % S:
+        raise ValueError(
+            f"n_layers={n_layers} not divisible by pipeline stages (--pp) {S}"
+        )
+
+    # Dtype of the activations at stage boundaries (ppermute payloads,
+    # microbatch buffers, and — via AD transposes — the pipeline-axis psums
+    # in the backward schedule). On CPU these must be f32: XLA's
+    # AllReducePromotion pass CHECK-fails ("Invalid binary instruction
+    # opcode copy") when cloning sub-f32 all-reduces. The bf16→f32→bf16
+    # round-trip is exact, so this changes bandwidth, not numerics; real
+    # TPU lowering keeps the wire format at the compute dtype.
+    io_dtype = jnp.float32 if jax.default_backend() == "cpu" else x.dtype
+
+    def stage_program(local_layers, mbs):
+        # local_layers: (L/S, ...) slice on this stage
+        # mbs: (M, b/M, seq, dim), replicated over the pipeline axis
+        s = jax.lax.axis_index(AXIS_PIPE)
+        fwd = [(i, i + 1) for i in range(S - 1)]
+
+        def local_stack(c):
+            def body(c, layer):
+                return block_fn(c, layer), None
+
+            out, _ = jax.lax.scan(body, c.astype(x.dtype), local_layers)
+            return out.astype(io_dtype)
+
+        def tick(carry_out, t):
+            carry, out = carry_out
+            inp = jax.lax.dynamic_index_in_dim(
+                mbs, jnp.clip(t, 0, M - 1), 0, keepdims=False
+            )
+            carry = jnp.where(s == 0, _pvary(inp), carry)
+            y = local_stack(carry)
+            # stage S-1 finishes microbatch (t - (S-1)) at tick t
+            oidx = t - (S - 1)
+            valid = jnp.logical_and(
+                s == S - 1, jnp.logical_and(oidx >= 0, oidx < M)
+            )
+            upd = jax.lax.dynamic_update_index_in_dim(
+                out, y, jnp.clip(oidx, 0, M - 1), 0
+            )
+            out = jnp.where(valid, upd, out)
+            carry = jax.lax.ppermute(y, AXIS_PIPE, fwd)
+            return (carry, out), None
+
+        carry0 = _pvary(jnp.zeros_like(mbs[0]))
+        out0 = _pvary(jnp.zeros_like(mbs))
+        (_, out), _ = jax.lax.scan(tick, (carry0, out0), jnp.arange(M + S - 1))
+        # results live on the last stage only; replicate them back over the
+        # pipeline axis (masked psum — everyone else contributes zeros)
+        return jax.lax.psum(jnp.where(s == S - 1, out, 0.0), AXIS_PIPE)
+
+    mbs = x.reshape(M, b // M, *x.shape[1:]).astype(io_dtype)
+    out = jax.shard_map(
+        stage_program,
+        mesh=mesh,
+        in_specs=(P(AXIS_PIPE), P()),
+        out_specs=P(),
+        axis_names={AXIS_PIPE},
+    )(layer_params, mbs)
+    return out.reshape(b, *x.shape[1:]).astype(x.dtype)

@@ -13,9 +13,18 @@ Rules are path-based over the parameter pytree produced by
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from pyrecover_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_TENSOR
+from pyrecover_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_PIPE,
+    AXIS_SEQ,
+    AXIS_TENSOR,
+)
 
-# name of final pytree leaf key -> spec factory, keyed on leaf ndim
+# name of final pytree leaf key -> spec factory, keyed on leaf ndim.
+# Layer-stacked leaves (L, ...) put the leading (layer) axis on the pipeline
+# mesh axis: each pipeline stage physically holds its contiguous L/S slice
+# (parallel.pipeline); with pipeline=1 that entry is inert.
 _RULES = {
     # embeddings: vocab replicated, model dim sharded over tensor×fsdp. A
     # vocab-sharded table would need a masked-gather+psum per lookup, which
@@ -25,18 +34,18 @@ _RULES = {
     "tok_embed": P(None, (AXIS_TENSOR, AXIS_FSDP)),
     # attention projections, stacked over layers at dim 0:
     #   wq/wk/wv (L, D, heads*hd): column parallel — output dim on tensor
-    "wq": P(None, AXIS_FSDP, AXIS_TENSOR),
-    "wk": P(None, AXIS_FSDP, AXIS_TENSOR),
-    "wv": P(None, AXIS_FSDP, AXIS_TENSOR),
+    "wq": P(AXIS_PIPE, AXIS_FSDP, AXIS_TENSOR),
+    "wk": P(AXIS_PIPE, AXIS_FSDP, AXIS_TENSOR),
+    "wv": P(AXIS_PIPE, AXIS_FSDP, AXIS_TENSOR),
     #   wo (L, heads*hd, D): row parallel — input dim on tensor
-    "wo": P(None, AXIS_TENSOR, AXIS_FSDP),
+    "wo": P(AXIS_PIPE, AXIS_TENSOR, AXIS_FSDP),
     # SwiGLU FFN (reference model.py:233-269 semantics):
-    "w1": P(None, AXIS_FSDP, AXIS_TENSOR),
-    "w3": P(None, AXIS_FSDP, AXIS_TENSOR),
-    "w2": P(None, AXIS_TENSOR, AXIS_FSDP),
-    # norms: replicated (tiny)
-    "attn_norm": P(None, None),
-    "ffn_norm": P(None, None),
+    "w1": P(AXIS_PIPE, AXIS_FSDP, AXIS_TENSOR),
+    "w3": P(AXIS_PIPE, AXIS_FSDP, AXIS_TENSOR),
+    "w2": P(AXIS_PIPE, AXIS_TENSOR, AXIS_FSDP),
+    # norms: replicated within a stage (tiny), layer axis on pipeline
+    "attn_norm": P(AXIS_PIPE, None),
+    "ffn_norm": P(AXIS_PIPE, None),
     "final_norm": P(None),
     # untied output projection (D, V) (reference model.py:367)
     "output": P(AXIS_FSDP, AXIS_TENSOR),

@@ -40,3 +40,49 @@ def tmp_ckpt_dir(tmp_path):
     d = tmp_path / "checkpoints"
     d.mkdir()
     return d
+
+
+def run_train_steps(mesh_cfg, model_cfg, train_cfg, n_steps=3, data_seed=3):
+    """Shared parallelism-test harness: run ``n_steps`` of training —
+    single-device when ``mesh_cfg`` is None, else on the given mesh — and
+    return ``(final_state, losses)``. Used by test_parallel / test_pipeline
+    to compare sharded runs against the single-device reference."""
+    import contextlib
+
+    from pyrecover_tpu.data import (
+        DataLoader,
+        StatefulSampler,
+        SyntheticTextDataset,
+    )
+    from pyrecover_tpu.optim import build_optimizer
+    from pyrecover_tpu.parallel.mesh import create_mesh
+    from pyrecover_tpu.train import init_sharded_state
+    from pyrecover_tpu.train_state import create_train_state, make_train_step
+
+    optimizer, _ = build_optimizer(train_cfg)
+    ds = SyntheticTextDataset(
+        num_samples=64, seq_len=train_cfg.sequence_length,
+        vocab_size=model_cfg.vocab_size, seed=data_seed,
+    )
+    sampler = StatefulSampler(
+        dataset_len=64, global_batch_size=train_cfg.batch_size, seed=data_seed
+    )
+
+    if mesh_cfg is None:
+        state = create_train_state(jax.random.key(0), model_cfg, optimizer)
+        loader = DataLoader(ds, sampler, pad_token_id=0, prefetch=0)
+        ctx = contextlib.nullcontext()
+    else:
+        mesh = create_mesh(mesh_cfg)
+        state = init_sharded_state(jax.random.key(0), model_cfg, optimizer, mesh)
+        loader = DataLoader(ds, sampler, pad_token_id=0, mesh=mesh, prefetch=0)
+        ctx = jax.sharding.set_mesh(mesh)
+
+    step_fn = make_train_step(model_cfg, optimizer, donate=False)
+    losses = []
+    with ctx:
+        for _ in range(n_steps):
+            _, batch = next(loader)
+            state, m = step_fn(state, batch)
+            losses.append(float(m["loss"]))
+    return state, losses

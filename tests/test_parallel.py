@@ -9,6 +9,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+from conftest import run_train_steps
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from pyrecover_tpu.config import TrainConfig
@@ -24,34 +25,8 @@ MODEL_CFG = ModelConfig().tiny(max_seq_len=32, vocab_size=128)
 TRAIN_CFG = TrainConfig(sequence_length=32, batch_size=8, learning_rate=1e-3)
 
 
-def run_steps(mesh_cfg, n_steps=3):
-    optimizer, _ = build_optimizer(TRAIN_CFG)
-    ds = SyntheticTextDataset(num_samples=64, seq_len=32,
-                              vocab_size=MODEL_CFG.vocab_size, seed=3)
-    sampler = StatefulSampler(dataset_len=64, global_batch_size=8, seed=3)
-
-    if mesh_cfg is None:
-        state = create_train_state(jax.random.key(0), MODEL_CFG, optimizer)
-        loader = DataLoader(ds, sampler, pad_token_id=0, prefetch=0)
-        step_fn = make_train_step(MODEL_CFG, optimizer, donate=False)
-        losses = []
-        for _ in range(n_steps):
-            _, batch = next(loader)
-            state, m = step_fn(state, batch)
-            losses.append(float(m["loss"]))
-        return state, losses
-
-    mesh = create_mesh(mesh_cfg)
-    state = init_sharded_state(jax.random.key(0), MODEL_CFG, optimizer, mesh)
-    loader = DataLoader(ds, sampler, pad_token_id=0, mesh=mesh, prefetch=0)
-    step_fn = make_train_step(MODEL_CFG, optimizer, donate=False)
-    losses = []
-    with jax.sharding.set_mesh(mesh):
-        for _ in range(n_steps):
-            _, batch = next(loader)
-            state, m = step_fn(state, batch)
-            losses.append(float(m["loss"]))
-    return state, losses
+def run_steps(mesh_cfg):
+    return run_train_steps(mesh_cfg, MODEL_CFG, TRAIN_CFG, data_seed=3)
 
 
 @pytest.fixture(scope="module")
@@ -87,12 +62,13 @@ def test_param_pspecs_shard_the_right_axes(devices8):
     mesh = create_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
     optimizer, _ = build_optimizer(TRAIN_CFG)
     state = init_sharded_state(jax.random.key(0), MODEL_CFG, optimizer, mesh)
-    # wq: (L, dim, heads*hd) — sharded (None, fsdp, tensor)
+    # wq: (L, dim, heads*hd) — layer axis on (size-1 here) pipeline,
+    # then (fsdp, tensor)
     wq = state.params["layers"]["wq"]
-    assert wq.sharding.spec == P(None, "fsdp", "tensor")
+    assert wq.sharding.spec == P("pipeline", "fsdp", "tensor")
     # optimizer moments mirror params shardings
     mu_wq = state.opt_state[-1][0].mu["layers"]["wq"]
-    assert mu_wq.sharding.spec == P(None, "fsdp", "tensor")
+    assert mu_wq.sharding.spec == P("pipeline", "fsdp", "tensor")
     # each device holds 1/4 of the leaf (fsdp×tensor shards, data-replicated)
     shard = wq.addressable_shards[0]
     assert shard.data.size == wq.size // 4
