@@ -128,6 +128,14 @@ def build_parser():
                    help="Used with synthetic data; with a tokenizer, its vocab size wins.")
     p.add_argument("--use_flash_attention", "--use-flash-attention",
                    dest="use_flash_attention", action="store_true")
+    p.add_argument("--moe-experts", type=int, default=d.model.n_experts,
+                   help="number of MoE experts per FFN; 0 = dense (reference)")
+    p.add_argument("--moe-top-k", type=int, default=d.model.moe_top_k)
+    p.add_argument("--moe-capacity-factor", type=float,
+                   default=d.model.moe_capacity_factor)
+    p.add_argument("--moe-aux-weight", type=float,
+                   default=d.model.moe_aux_weight,
+                   help="load-balance aux loss scale")
     p.add_argument("--remat", action="store_true",
                    help="Rematerialize transformer blocks (trade FLOPs for HBM).")
     p.add_argument("--loss-chunk-size", type=int, default=0,
@@ -144,6 +152,8 @@ def build_parser():
                    help="pipeline-parallel stages (layers sharded across stages)")
     p.add_argument("--pp-microbatches", type=int, default=d.pp_microbatches,
                    help="pipeline microbatch count; 0 = number of stages")
+    p.add_argument("--ep", type=int, default=d.mesh.expert,
+                   help="expert-parallel axis size (MoE experts sharded)")
 
     # checkpointing (utils.py:190-232)
     p.add_argument("--checkpoint-dir", type=str, default=d.checkpoint_dir)
@@ -184,6 +194,10 @@ def get_args(argv=None):
         n_heads=ns.model_heads,
         n_kv_heads=ns.model_kv_heads,
         vocab_size=ns.vocab_size,
+        n_experts=ns.moe_experts,
+        moe_top_k=ns.moe_top_k,
+        moe_capacity_factor=ns.moe_capacity_factor,
+        moe_aux_weight=ns.moe_aux_weight,
     )
     return TrainConfig(
         dataset=ns.dataset,
@@ -205,7 +219,7 @@ def get_args(argv=None):
         remat=ns.remat,
         loss_chunk_size=ns.loss_chunk_size,
         mesh=MeshConfig(data=ns.dp, fsdp=ns.fsdp, tensor=ns.tp, sequence=ns.sp,
-                        pipeline=ns.pp),
+                        pipeline=ns.pp, expert=ns.ep),
         pp_microbatches=ns.pp_microbatches,
         distributed=ns.distributed,
         checkpoint_dir=ns.checkpoint_dir,

@@ -58,10 +58,27 @@ class ModelConfig:
     remat: bool = False
     flash_block_q: int = 512
     flash_block_kv: int = 512
+    # -- mixture of experts (0 experts = dense; reference is dense-only) --
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01  # load-balance loss scale
+    moe_ffn_hidden: int = 0  # per-expert hidden size; 0 → ffn_hidden_dim
+
+    def __post_init__(self):
+        if self.n_experts > 0 and self.moe_top_k > self.n_experts:
+            raise ValueError(
+                f"moe_top_k={self.moe_top_k} must be <= "
+                f"n_experts (--moe-experts) = {self.n_experts}"
+            )
 
     @property
     def head_dim(self):
         return self.dim // self.n_heads
+
+    @property
+    def expert_hidden_dim(self):
+        return self.moe_ffn_hidden or self.ffn_hidden_dim
 
     @property
     def ffn_hidden_dim(self):
@@ -110,19 +127,33 @@ def init_params(rng, config):
         ks = jax.random.split(key, L)
         return jnp.stack([_normal_init(k, shape, s, pdt) for k in ks])
 
-    params = {
-        "tok_embed": _normal_init(keys[0], (cfg.vocab_size, cfg.dim), std, pdt),
-        "layers": {
-            "attn_norm": jnp.ones((L, cfg.dim), dtype=pdt),
-            "wq": stacked(keys[1], (cfg.dim, cfg.n_heads * hd), std),
-            "wk": stacked(keys[2], (cfg.dim, cfg.n_kv_heads * hd), std),
-            "wv": stacked(keys[3], (cfg.dim, cfg.n_kv_heads * hd), std),
-            "wo": stacked(keys[4], (cfg.n_heads * hd, cfg.dim), resid_std),
-            "ffn_norm": jnp.ones((L, cfg.dim), dtype=pdt),
+    layers = {
+        "attn_norm": jnp.ones((L, cfg.dim), dtype=pdt),
+        "wq": stacked(keys[1], (cfg.dim, cfg.n_heads * hd), std),
+        "wk": stacked(keys[2], (cfg.dim, cfg.n_kv_heads * hd), std),
+        "wv": stacked(keys[3], (cfg.dim, cfg.n_kv_heads * hd), std),
+        "wo": stacked(keys[4], (cfg.n_heads * hd, cfg.dim), resid_std),
+        "ffn_norm": jnp.ones((L, cfg.dim), dtype=pdt),
+    }
+    if cfg.n_experts > 0:
+        E, F = cfg.n_experts, cfg.expert_hidden_dim
+        layers.update({
+            # router in f32 regardless of param dtype: routing decisions are
+            # discrete (top-k), so router precision moves token assignment
+            "router": stacked(keys[5], (cfg.dim, E), std).astype(jnp.float32),
+            "moe_w1": stacked(keys[6], (E, cfg.dim, F), std),
+            "moe_w3": stacked(keys[7], (E, cfg.dim, F), std),
+            "moe_w2": stacked(keys[9], (E, F, cfg.dim), resid_std),
+        })
+    else:
+        layers.update({
             "w1": stacked(keys[5], (cfg.dim, ffn), std),
             "w3": stacked(keys[6], (cfg.dim, ffn), std),
             "w2": stacked(keys[7], (ffn, cfg.dim), resid_std),
-        },
+        })
+    params = {
+        "tok_embed": _normal_init(keys[0], (cfg.vocab_size, cfg.dim), std, pdt),
+        "layers": layers,
         "final_norm": jnp.ones((cfg.dim,), dtype=pdt),
         "output": _normal_init(keys[8], (cfg.dim, cfg.vocab_size), std, pdt),
     }
@@ -154,7 +185,11 @@ def _attention_fn(config):
 
 
 def _block(x, layer, cos, sin, config, attn_fn):
-    """One pre-norm transformer block (reference model.py:272-327)."""
+    """One pre-norm transformer block (reference model.py:272-327).
+
+    Returns ``(x, aux)`` where aux is the per-row MoE load-balance loss
+    ((B,) f32; zeros for dense FFN layers).
+    """
     cfg = config
     cdt = resolve_dtype(cfg.compute_dtype)
     b, s, d = x.shape
@@ -178,22 +213,34 @@ def _block(x, layer, cos, sin, config, attn_fn):
     x = x + attn @ layer["wo"].astype(cdt)
     x = constrain(x, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, None)
 
-    # --- SwiGLU FFN sublayer (reference model.py:268-269) ---
+    # --- FFN sublayer: dense SwiGLU (reference model.py:268-269) or MoE ---
     h = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
-    gate = jax.nn.silu(h @ layer["w1"].astype(cdt))
-    up = h @ layer["w3"].astype(cdt)
-    x = x + (gate * up) @ layer["w2"].astype(cdt)
+    if cfg.n_experts > 0:
+        from pyrecover_tpu.models.moe import moe_ffn
+
+        y, aux = moe_ffn(
+            h, layer["router"], layer["moe_w1"], layer["moe_w3"],
+            layer["moe_w2"], cfg,
+        )
+        x = x + y
+    else:
+        gate = jax.nn.silu(h @ layer["w1"].astype(cdt))
+        up = h @ layer["w3"].astype(cdt)
+        x = x + (gate * up) @ layer["w2"].astype(cdt)
+        aux = jnp.zeros((b,), dtype=jnp.float32)
     x = constrain(x, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, None)
-    return x
+    return x, aux
 
 
-def forward_hidden(params, tokens, config):
-    """Embed → n_layers pre-norm blocks → final RMSNorm; returns the hidden
-    states (batch, seq, dim) BEFORE the vocab projection. Split out so the
-    loss can fuse projection + cross-entropy per sequence chunk without ever
-    materializing (batch, seq, vocab) logits (an HBM-bandwidth/capacity
-    optimization the reference, which always materializes full logits at
-    train.py:262-266, has no analogue of)."""
+def forward_hidden_with_aux(params, tokens, config):
+    """Embed → n_layers pre-norm blocks → final RMSNorm; returns
+    ``(hidden, aux)``: the hidden states (batch, seq, dim) BEFORE the vocab
+    projection (split out so the loss can fuse projection + cross-entropy
+    per sequence chunk without ever materializing (batch, seq, vocab)
+    logits — an HBM optimization the reference, which always materializes
+    full logits at train.py:262-266, has no analogue of), and the scalar
+    MoE load-balance aux loss summed over layers, averaged over rows
+    (0 for dense models)."""
     cfg = config
     cdt = resolve_dtype(cfg.compute_dtype)
     seq_len = tokens.shape[1]
@@ -205,9 +252,17 @@ def forward_hidden(params, tokens, config):
     x = constrain(x, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, None)
 
     block = partial(_block, cos=cos, sin=sin, config=cfg, attn_fn=attn_fn)
+
+    # Carry = {"x": activations, "aux": per-row aux accumulator}. Per-row
+    # (not scalar) so pipeline microbatching splits it along the batch like
+    # everything else and the result is identical with and without PP.
+    def block_carry(carry, layer):
+        new_x, aux = block(carry["x"], layer)
+        return {"x": new_x, "aux": carry["aux"] + aux}
+
     if cfg.remat:
-        block = jax.checkpoint(
-            block, policy=jax.checkpoint_policies.nothing_saveable
+        block_carry = jax.checkpoint(
+            block_carry, policy=jax.checkpoint_policies.nothing_saveable
         )
 
     # Under a mesh with a pipeline axis >1 this runs the microbatched
@@ -215,11 +270,22 @@ def forward_hidden(params, tokens, config):
     # a plain lax.scan over the stacked layers.
     from pyrecover_tpu.parallel.pipeline import pipeline_blocks
 
-    x = pipeline_blocks(
-        params["layers"], x, block, n_microbatches=cfg.pp_microbatches
+    carry = {
+        "x": x,
+        "aux": jnp.zeros((x.shape[0],), dtype=jnp.float32),
+    }
+    carry = pipeline_blocks(
+        params["layers"], carry, block_carry,
+        n_microbatches=cfg.pp_microbatches,
     )
 
-    return rms_norm(x, params["final_norm"], cfg.norm_eps)
+    hidden = rms_norm(carry["x"], params["final_norm"], cfg.norm_eps)
+    return hidden, jnp.mean(carry["aux"])
+
+
+def forward_hidden(params, tokens, config):
+    """`forward_hidden_with_aux` without the aux loss (dense callers)."""
+    return forward_hidden_with_aux(params, tokens, config)[0]
 
 
 def project_vocab(params, hidden, config):

@@ -1,0 +1,97 @@
+"""Mixture-of-Experts SwiGLU FFN with expert parallelism.
+
+The reference is dense-only (SURVEY §2.2: "Expert parallel (EP/MoE): No —
+dense SwiGLU only, model.py:233-269"). This is the TPU-native MoE
+construction — einsum-based masked dispatch (Switch-Transformer style)
+rather than scatter/gather token shuffling:
+
+  * Routing, capacity masking, and dispatch/combine are all dense einsums
+    over static shapes — exactly what the MXU and XLA's SPMD partitioner
+    want. No dynamic shapes, no sorting networks.
+  * Expert-stacked weights ``(E, D, F)`` are sharded on their expert axis
+    over the ``expert`` mesh axis; annotating the ``(B, E, C, D)`` expert
+    inputs with the same axis turns the dispatch/combine einsums into
+    all-to-alls over ICI, inserted by the compiler.
+  * Each batch row is a routing group: capacity and the load-balance aux
+    loss are computed per row, which keeps every statistic local under
+    data sharding AND under pipeline microbatching (a microbatch is a
+    subset of rows, so per-row aux values are identical either way).
+
+Top-k routing renormalizes the selected gate probabilities (Mixtral-style);
+the aux loss is the Switch load-balance loss ``E · Σ_e f_e·p_e`` per row.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from pyrecover_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    constrain,
+)
+
+
+def moe_capacity(seq_len, n_experts, top_k, capacity_factor):
+    """Per-row expert capacity: ceil(S·k·cf / E), min 1. Static."""
+    return max(1, int(math.ceil(seq_len * top_k * capacity_factor / n_experts)))
+
+
+def moe_ffn(h, router_w, w1, w3, w2, config):
+    """MoE SwiGLU: route each token to its top-k experts, run the expert
+    FFNs at fixed capacity, combine weighted outputs.
+
+    Args:
+      h: (B, S, D) activations (compute dtype).
+      router_w: (D, E) router weights.
+      w1, w3: (E, D, F) expert gate/up projections; w2: (E, F, D) down.
+      config: ModelConfig with n_experts / moe_top_k / moe_capacity_factor.
+
+    Returns:
+      (y, aux): y (B, S, D) same dtype as h; aux (B,) f32 per-row
+      load-balance loss (caller scales by ``moe_aux_weight``).
+    """
+    cfg = config
+    B, S, D = h.shape
+    E, K = cfg.n_experts, cfg.moe_top_k
+    C = moe_capacity(S, E, K, cfg.moe_capacity_factor)
+    f32 = jnp.float32
+
+    # --- routing (f32 for a stable softmax) ---
+    logits = jnp.einsum("bsd,de->bse", h.astype(f32), router_w.astype(f32))
+    probs = jax.nn.softmax(logits, axis=-1)  # (B,S,E)
+    gate_vals, gate_idx = jax.lax.top_k(probs, K)  # (B,S,K)
+    gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+    onehot = jax.nn.one_hot(gate_idx, E, dtype=f32)  # (B,S,K,E)
+
+    # --- capacity assignment: position of each (token, slot) in its
+    # expert's queue, in (s, k) order within the row ---
+    flat = onehot.reshape(B, S * K, E)
+    prio = jnp.cumsum(flat, axis=1) - flat  # 0-based queue position
+    prio = prio.reshape(B, S, K, E)
+    keep = onehot * (prio < C)  # drop overflow tokens
+    slot = jax.nn.one_hot(prio.astype(jnp.int32), C, dtype=f32)  # (B,S,K,E,C)
+    slot = slot * keep[..., None]
+    dispatch = slot.sum(axis=2)  # (B,S,E,C) ∈ {0,1}
+    combine = (slot * gate_vals[..., None, None]).sum(axis=2)  # (B,S,E,C)
+
+    # --- expert compute at fixed capacity ---
+    cdt = h.dtype
+    xin = jnp.einsum("bsec,bsd->becd", dispatch.astype(cdt), h)
+    xin = constrain(xin, (AXIS_DATA, AXIS_FSDP), AXIS_EXPERT, None, None)
+    gate = jax.nn.silu(jnp.einsum("becd,edf->becf", xin, w1.astype(cdt)))
+    up = jnp.einsum("becd,edf->becf", xin, w3.astype(cdt))
+    out = jnp.einsum("becf,efd->becd", gate * up, w2.astype(cdt))
+    out = constrain(out, (AXIS_DATA, AXIS_FSDP), AXIS_EXPERT, None, None)
+    y = jnp.einsum("bsec,becd->bsd", combine.astype(cdt), out)
+
+    # --- Switch load-balance aux loss, per row: E · Σ_e f_e·p_e where
+    # f_e = fraction of (token, slot) picks routed to e (pre-capacity;
+    # sums to 1 over experts), p_e = mean router probability over the row.
+    # Minimized (=1) by a uniform router; spikes when experts collapse. ---
+    f_e = onehot.mean(axis=(1, 2))  # (B,E)
+    p_e = probs.mean(axis=1)  # (B,E)
+    aux = E * jnp.sum(f_e * p_e, axis=-1)  # (B,) f32
+    return y.astype(h.dtype), aux

@@ -36,10 +36,24 @@ def llama_150m(max_seq_len=1024, vocab_size=32768):
     )
 
 
+def moe_8x1b(max_seq_len=2048, vocab_size=32768):
+    """Mixtral-style sparse model: the llama-1b backbone with 8 top-2
+    experts per FFN (≈6.9B params, ~2.3B active per token). The reference
+    has no MoE (SURVEY §2.2) — this preset exists to exercise expert
+    parallelism at a benchmarkable scale."""
+    return ModelConfig(
+        dim=2048, n_layers=20, n_heads=16, n_kv_heads=8,
+        ffn_dim_multiplier=1.3, multiple_of=1024, rope_theta=500000.0,
+        vocab_size=vocab_size, max_seq_len=max_seq_len,
+        n_experts=8, moe_top_k=2,
+    )
+
+
 PRESETS = {
     "llama-8b": llama_8b,
     "llama-1b": llama_1b,
     "llama-150m": llama_150m,
+    "moe-8x1b": moe_8x1b,
 }
 
 
@@ -48,14 +62,17 @@ def analytic_param_count(cfg):
     capability of the reference's model smoke test (test_model.py:6-25),
     which instantiates the full 8B model just to count."""
     hd = cfg.head_dim
-    ffn = cfg.ffn_hidden_dim
     per_layer = (
         2 * cfg.dim
         + cfg.dim * cfg.n_heads * hd
         + 2 * cfg.dim * cfg.n_kv_heads * hd
         + cfg.n_heads * hd * cfg.dim
-        + 3 * cfg.dim * ffn
     )
+    if cfg.n_experts > 0:
+        per_layer += cfg.dim * cfg.n_experts  # router
+        per_layer += cfg.n_experts * 3 * cfg.dim * cfg.expert_hidden_dim
+    else:
+        per_layer += 3 * cfg.dim * cfg.ffn_hidden_dim
     return (
         cfg.vocab_size * cfg.dim
         + cfg.n_layers * per_layer

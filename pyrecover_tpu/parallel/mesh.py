@@ -24,6 +24,11 @@ Mesh axes:
                    ``ppermute`` inside a ``shard_map`` schedule
                    (`parallel.pipeline`). The reference has no PP
                    (SURVEY §2.2).
+  * ``expert``   — expert parallelism for MoE layers: expert-stacked FFN
+                   weights are sharded on their expert axis and token
+                   dispatch/combine einsums become all-to-alls over this
+                   axis (models/moe.py). The reference is dense-only
+                   (SURVEY §2.2).
 """
 
 import dataclasses
@@ -38,8 +43,9 @@ AXIS_FSDP = "fsdp"
 AXIS_TENSOR = "tensor"
 AXIS_SEQ = "sequence"
 AXIS_PIPE = "pipeline"
+AXIS_EXPERT = "expert"
 
-MESH_AXES = (AXIS_PIPE, AXIS_DATA, AXIS_FSDP, AXIS_TENSOR, AXIS_SEQ)
+MESH_AXES = (AXIS_PIPE, AXIS_DATA, AXIS_FSDP, AXIS_TENSOR, AXIS_SEQ, AXIS_EXPERT)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -56,24 +62,31 @@ class MeshConfig:
     tensor: int = 1
     sequence: int = 1
     pipeline: int = 1
+    expert: int = 1
 
     def resolve(self, n_devices):
-        fixed = self.fsdp * self.tensor * self.sequence * self.pipeline
+        fixed = (
+            self.fsdp * self.tensor * self.sequence * self.pipeline * self.expert
+        )
         data = self.data
         if data == -1:
             if n_devices % fixed != 0:
                 raise ValueError(
                     f"{n_devices} devices not divisible by "
-                    f"pipeline*fsdp*tensor*sequence={fixed}"
+                    f"pipeline*fsdp*tensor*sequence*expert={fixed}"
                 )
             data = n_devices // fixed
         total = data * fixed
         if total != n_devices:
             raise ValueError(
-                f"Mesh {self.pipeline}x{data}x{self.fsdp}x{self.tensor}"
-                f"x{self.sequence}={total} != available devices {n_devices}"
+                f"Mesh pp{self.pipeline}xdp{data}xfsdp{self.fsdp}"
+                f"xtp{self.tensor}xsp{self.sequence}xep{self.expert}={total} "
+                f"!= available devices {n_devices}"
             )
-        return (self.pipeline, data, self.fsdp, self.tensor, self.sequence)
+        return (
+            self.pipeline, data, self.fsdp, self.tensor, self.sequence,
+            self.expert,
+        )
 
 
 def create_mesh(config=None, devices=None):

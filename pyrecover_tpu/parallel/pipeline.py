@@ -46,18 +46,20 @@ def _pvary(x):
 
 
 def pipeline_blocks(layer_params, x, block_fn, n_microbatches=0):
-    """Run ``x`` through the full layer stack across pipeline stages.
+    """Run carry ``x`` through the full layer stack across pipeline stages.
 
     Args:
       layer_params: pytree with leaves stacked ``(L, ...)``, sharded on the
         leading axis over the ``pipeline`` mesh axis.
-      x: activations ``(batch, seq, dim)``; batch must be divisible by the
-        microbatch count.
-      block_fn: ``(x_mb, layer_slice) -> x_mb`` — one transformer block on
-        one microbatch (already remat-wrapped by the caller if desired).
+      x: carry pytree — every leaf has a leading ``batch`` dim (e.g.
+        ``{"x": (B, S, D), "aux": (B,)}``); batch must be divisible by the
+        microbatch count. A bare array works too.
+      block_fn: ``(carry_mb, layer_slice) -> carry_mb`` — one transformer
+        block on one microbatch (already remat-wrapped by the caller if
+        desired).
       n_microbatches: microbatch count ``M``; 0 → the stage count.
 
-    Returns activations ``(batch, seq, dim)`` after all L layers.
+    Returns the carry pytree after all L layers.
     """
     mesh = jax.sharding.get_abstract_mesh()
     n_stages = pipeline_axis_size()
@@ -69,9 +71,10 @@ def pipeline_blocks(layer_params, x, block_fn, n_microbatches=0):
         out, _ = jax.lax.scan(body, x, layer_params)
         return out
 
+    tmap = jax.tree_util.tree_map
     M = int(n_microbatches) if n_microbatches else n_stages
     S = n_stages
-    b = x.shape[0]
+    b = jax.tree_util.tree_leaves(x)[0].shape[0]
     if b % M:
         raise ValueError(f"batch {b} not divisible by {M} microbatches")
     n_layers = jax.tree_util.tree_leaves(layer_params)[0].shape[0]
@@ -87,11 +90,21 @@ def pipeline_blocks(layer_params, x, block_fn, n_microbatches=0):
     # opcode copy") when cloning sub-f32 all-reduces. The bf16→f32→bf16
     # round-trip is exact, so this changes bandwidth, not numerics; real
     # TPU lowering keeps the wire format at the compute dtype.
-    io_dtype = jnp.float32 if jax.default_backend() == "cpu" else x.dtype
+    on_cpu = jax.default_backend() == "cpu"
+
+    def to_io(leaf):
+        if on_cpu and jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf.astype(jnp.float32)
+        return leaf
+
+    orig_dtypes = tmap(lambda l: l.dtype, x)
+
+    def from_io(tree):
+        return tmap(lambda l, dt: l.astype(dt), tree, orig_dtypes)
 
     def stage_program(local_layers, mbs):
         # local_layers: (L/S, ...) slice on this stage
-        # mbs: (M, b/M, seq, dim), replicated over the pipeline axis
+        # mbs: leaves (M, b/M, ...), replicated over the pipeline axis
         s = jax.lax.axis_index(AXIS_PIPE)
         fwd = [(i, i + 1) for i in range(S - 1)]
 
@@ -99,36 +112,50 @@ def pipeline_blocks(layer_params, x, block_fn, n_microbatches=0):
             def body(c, layer):
                 return block_fn(c, layer), None
 
-            out, _ = jax.lax.scan(body, c.astype(x.dtype), local_layers)
-            return out.astype(io_dtype)
+            out, _ = jax.lax.scan(body, from_io(c), local_layers)
+            return tmap(to_io, out)
 
         def tick(carry_out, t):
             carry, out = carry_out
-            inp = jax.lax.dynamic_index_in_dim(
-                mbs, jnp.clip(t, 0, M - 1), 0, keepdims=False
+            inp = tmap(
+                lambda m: jax.lax.dynamic_index_in_dim(
+                    m, jnp.clip(t, 0, M - 1), 0, keepdims=False
+                ),
+                mbs,
             )
-            carry = jnp.where(s == 0, _pvary(inp), carry)
+            carry = tmap(
+                lambda i, c: jnp.where(s == 0, _pvary(i), c), inp, carry
+            )
             y = local_stack(carry)
             # stage S-1 finishes microbatch (t - (S-1)) at tick t
             oidx = t - (S - 1)
             valid = jnp.logical_and(
                 s == S - 1, jnp.logical_and(oidx >= 0, oidx < M)
             )
-            upd = jax.lax.dynamic_update_index_in_dim(
-                out, y, jnp.clip(oidx, 0, M - 1), 0
+            out = tmap(
+                lambda o, yy: jnp.where(
+                    valid,
+                    jax.lax.dynamic_update_index_in_dim(
+                        o, yy, jnp.clip(oidx, 0, M - 1), 0
+                    ),
+                    o,
+                ),
+                out,
+                y,
             )
-            out = jnp.where(valid, upd, out)
             carry = jax.lax.ppermute(y, AXIS_PIPE, fwd)
             return (carry, out), None
 
-        carry0 = _pvary(jnp.zeros_like(mbs[0]))
-        out0 = _pvary(jnp.zeros_like(mbs))
+        carry0 = tmap(lambda m: _pvary(jnp.zeros_like(m[0])), mbs)
+        out0 = tmap(lambda m: _pvary(jnp.zeros_like(m)), mbs)
         (_, out), _ = jax.lax.scan(tick, (carry0, out0), jnp.arange(M + S - 1))
         # results live on the last stage only; replicate them back over the
         # pipeline axis (masked psum — everyone else contributes zeros)
-        return jax.lax.psum(jnp.where(s == S - 1, out, 0.0), AXIS_PIPE)
+        return jax.lax.psum(
+            tmap(lambda o: jnp.where(s == S - 1, o, 0.0), out), AXIS_PIPE
+        )
 
-    mbs = x.reshape(M, b // M, *x.shape[1:]).astype(io_dtype)
+    mbs = tmap(lambda l: to_io(l.reshape(M, b // M, *l.shape[1:])), x)
     out = jax.shard_map(
         stage_program,
         mesh=mesh,
@@ -136,4 +163,4 @@ def pipeline_blocks(layer_params, x, block_fn, n_microbatches=0):
         out_specs=P(),
         axis_names={AXIS_PIPE},
     )(layer_params, mbs)
-    return out.reshape(b, *x.shape[1:]).astype(x.dtype)
+    return from_io(tmap(lambda l: l.reshape(b, *l.shape[2:]), out))

@@ -15,6 +15,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from pyrecover_tpu.parallel.mesh import (
     AXIS_DATA,
+    AXIS_EXPERT,
     AXIS_FSDP,
     AXIS_PIPE,
     AXIS_SEQ,
@@ -43,6 +44,12 @@ _RULES = {
     "w1": P(AXIS_PIPE, AXIS_FSDP, AXIS_TENSOR),
     "w3": P(AXIS_PIPE, AXIS_FSDP, AXIS_TENSOR),
     "w2": P(AXIS_PIPE, AXIS_TENSOR, AXIS_FSDP),
+    # MoE (models/moe.py): experts on the expert axis, then the usual
+    # column/row split of each expert's SwiGLU over fsdp×tensor
+    "router": P(AXIS_PIPE, None, None),
+    "moe_w1": P(AXIS_PIPE, AXIS_EXPERT, AXIS_FSDP, AXIS_TENSOR),
+    "moe_w3": P(AXIS_PIPE, AXIS_EXPERT, AXIS_FSDP, AXIS_TENSOR),
+    "moe_w2": P(AXIS_PIPE, AXIS_EXPERT, AXIS_TENSOR, AXIS_FSDP),
     # norms: replicated within a stage (tiny), layer axis on pipeline
     "attn_norm": P(AXIS_PIPE, None),
     "ffn_norm": P(AXIS_PIPE, None),

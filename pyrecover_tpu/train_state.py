@@ -67,14 +67,13 @@ def masked_cross_entropy(logits, labels):
     return loss_sum / jnp.maximum(n_valid, 1).astype(jnp.float32), n_valid
 
 
-def chunked_loss(params, tokens, labels, model_config, chunk_size):
+def chunked_ce(params, hidden, labels, model_config, chunk_size):
     """Fused projection + CE over sequence chunks: never materializes the
     full (batch, seq, vocab) logits — the dominant HBM cost of the naive
     loss at LLM vocab sizes. ``lax.map`` over chunks keeps one chunk of
     logits live at a time (in fwd AND in the scanned backward)."""
-    from pyrecover_tpu.models.llama import forward_hidden, project_vocab
+    from pyrecover_tpu.models.llama import project_vocab
 
-    hidden = forward_hidden(params, tokens, model_config)
     b, s, d = hidden.shape
     if chunk_size <= 0 or s % chunk_size or s == chunk_size:
         logits = project_vocab(params, hidden, model_config)
@@ -98,6 +97,14 @@ def chunked_loss(params, tokens, labels, model_config, chunk_size):
     return jnp.sum(sums) / jnp.maximum(n_valid, 1).astype(jnp.float32), n_valid
 
 
+def chunked_loss(params, tokens, labels, model_config, chunk_size):
+    """Forward + `chunked_ce` (kept as the standalone fused-loss entry)."""
+    from pyrecover_tpu.models.llama import forward_hidden
+
+    hidden = forward_hidden(params, tokens, model_config)
+    return chunked_ce(params, hidden, labels, model_config, chunk_size)
+
+
 def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0):
     """Build the jitted functional train step.
 
@@ -110,17 +117,22 @@ def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0):
 
     def step_fn(state, batch):
         def loss_fn(params):
-            if loss_chunk_size > 0:
-                return chunked_loss(
-                    params, batch["inputs"], batch["labels"],
-                    model_config, loss_chunk_size,
-                )
-            logits = forward(params, batch["inputs"], model_config)
-            return masked_cross_entropy(logits, batch["labels"])
+            from pyrecover_tpu.models.llama import forward_hidden_with_aux
 
-        (loss, n_valid), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params
-        )
+            hidden, moe_aux = forward_hidden_with_aux(
+                params, batch["inputs"], model_config
+            )
+            ce, n_valid = chunked_ce(
+                params, hidden, batch["labels"], model_config, loss_chunk_size
+            )
+            total = ce
+            if model_config.n_experts > 0:
+                total = ce + model_config.moe_aux_weight * moe_aux
+            return total, (ce, n_valid, moe_aux)
+
+        (_, (loss, n_valid, moe_aux)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
         updates, new_opt_state = optimizer.update(
             grads, state.opt_state, state.params
         )
@@ -137,9 +149,10 @@ def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0):
             rng=new_rng,
         )
         metrics = {
-            "loss": loss,
+            "loss": loss,  # CE only — comparable to the reference's loss CSV
             "n_tokens": n_valid,
             "grad_norm": grad_norm,
+            "moe_aux": moe_aux,
         }
         return new_state, metrics
 
