@@ -56,8 +56,9 @@ class ModelConfig:
     attention_impl: str = "sdpa"  # "sdpa" | "flash" | "ring"
     pp_microbatches: int = 0  # pipeline microbatch count; 0 → stage count
     remat: bool = False
-    flash_block_q: int = 512
-    flash_block_kv: int = 512
+    # tuned on v5e at 1B/seq-2048: 1024x1024 beats 512x512 by ~6% MFU
+    flash_block_q: int = 1024
+    flash_block_kv: int = 1024
     # -- mixture of experts (0 experts = dense; reference is dense-only) --
     n_experts: int = 0
     moe_top_k: int = 2

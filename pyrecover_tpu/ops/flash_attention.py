@@ -34,6 +34,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 LANES = 128  # TPU lane width: scratch vectors are (bq, 128) replicated
+# logsumexp is per (batch, head, position) but stored with a small lane dim
+# (f32 sublane tile) — 8 instead of 128 keeps the HBM side 16x smaller; the
+# 1B bench point OOMs with full-lane replication.
+LSE_LANES = 8
 
 
 def _interpret():
@@ -97,7 +101,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_safe = jnp.where(l > 0.0, l, 1.0)
         o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
         # logsumexp for the backward pass
-        lse_ref[0, 0] = (m_scr[:] + jnp.log(l_safe)).astype(jnp.float32)
+        lse_ref[0, 0] = (
+            m_scr[:, :LSE_LANES] + jnp.log(jnp.broadcast_to(l_safe, (l_safe.shape[0], LSE_LANES)))
+        ).astype(jnp.float32)
 
 
 def _fwd(q, k, v, *, causal, scale, block_q, block_kv):
@@ -130,11 +136,12 @@ def _fwd(q, k, v, *, causal, scale, block_q, block_kv):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, LANES), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, LSE_LANES),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, hq, s, d), q.dtype),
-            jax.ShapeDtypeStruct((b, hq, s, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, s, LSE_LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, LANES), jnp.float32),
@@ -149,14 +156,21 @@ def _fwd(q, k, v, *, causal, scale, block_q, block_kv):
 # =========================== backward kernels ==============================
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   acc_scr, *, scale, block_q, block_kv, causal, num_kv_blocks):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
+                   acc_scr, delta_scr,
+                   *, scale, block_q, block_kv, causal, num_kv_blocks):
     iq = pl.program_id(2)
     ik = pl.program_id(3)
 
     @pl.when(ik == 0)
     def _init():
         acc_scr[:] = jnp.zeros_like(acc_scr)
+        # delta_i = rowsum(do·out): same for every kv block of this q block
+        do = do_ref[0, 0].astype(jnp.float32)
+        o = o_ref[0, 0].astype(jnp.float32)
+        delta_scr[:] = jnp.broadcast_to(
+            jnp.sum(do * o, axis=-1, keepdims=True), delta_scr.shape
+        )
 
     run = True
     if causal:
@@ -169,7 +183,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
         lse = lse_ref[0, 0][:, :1]
-        delta = delta_ref[0, 0][:, :1]
+        delta = delta_scr[:, :1]
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -196,13 +210,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0, 0] = acc_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale, block_q, block_kv, causal, num_q_blocks):
+                    *, scale, block_q, block_kv, causal, num_q_blocks, group):
     ik = pl.program_id(2)  # kv-major: kv block is the outer loop dim
-    iq = pl.program_id(3)
+    t = pl.program_id(3)  # sweeps (q_block, group member): iq = t // group
+    iq = t // group
 
-    @pl.when(iq == 0)
+    @pl.when(t == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -217,8 +232,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
+        o = o_ref[0, 0].astype(jnp.float32)
         lse = lse_ref[0, 0][:, :1]
-        delta = delta_ref[0, 0][:, :1]
+        delta = jnp.sum(do * o, axis=-1, keepdims=True)  # (bq, 1)
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -243,7 +259,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(iq == num_q_blocks - 1)
+    @pl.when(t == num_q_blocks * group - 1)
     def _finalize():
         dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
@@ -266,12 +282,6 @@ def _bwd(causal, scale, block_q, block_kv, res, g):
     dot = do.transpose(0, 2, 1, 3)
     outt = out.transpose(0, 2, 1, 3)
 
-    # delta_i = rowsum(do * out): cheap, fused by XLA — no kernel needed
-    delta = jnp.sum(
-        dot.astype(jnp.float32) * outt.astype(jnp.float32), axis=-1
-    )[..., None]  # (b, h, s, 1)
-    delta = jnp.broadcast_to(delta, (b, hq, s, LANES))
-
     dq_kernel = functools.partial(
         _bwd_dq_kernel, scale=scale, block_q=bq, block_kv=bk,
         causal=causal, num_kv_blocks=nk,
@@ -286,56 +296,63 @@ def _bwd(causal, scale, block_q, block_kv, res, g):
             pl.BlockSpec((1, 1, bk, d),
                          lambda bi, hi, qi, ki, g=group: (bi, hi // g, ki, 0)),
             pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, LANES), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, LANES), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, LSE_LANES),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b, hq, s, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+        ],
         interpret=_interpret(),
-    )(qt, kt, vt, dot, lse, delta)
+    )(qt, kt, vt, dot, outt, lse)
 
-    # dk/dv: one (b, q_head, kv_block) owner per output block; the group's
-    # q-head contributions are summed afterwards (cheap reshape-sum)
+    # dk/dv: grid dim 3 sweeps (q_block × GQA group member) so the whole
+    # group's contribution accumulates in VMEM scratch and each output
+    # block is written once, directly at kv-head granularity — no
+    # (b, q_heads, s, d) f32 intermediates (2×2.1G at the 1B bench point)
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, scale=scale, block_q=bq, block_kv=bk,
-        causal=causal, num_q_blocks=nq,
+        causal=causal, num_q_blocks=nq, group=group,
     )
-    dk_per_h, dv_per_h = pl.pallas_call(
+    qhead = lambda hi, t, g=group: hi * g + t % g  # noqa: E731
+    qblock = lambda t, g=group: t // g  # noqa: E731
+    dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(b, hq, nk, nq),
+        grid=(b, hkv, nk, nq * group),
         in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda bi, hi, ki, qi, g=group: (bi, hi // g, ki, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda bi, hi, ki, qi, g=group: (bi, hi // g, ki, 0)),
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, LANES), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, LANES), lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda bi, hi, ki, t: (bi, qhead(hi, t), qblock(t), 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, t: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, t: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda bi, hi, ki, t: (bi, qhead(hi, t), qblock(t), 0)),
+            pl.BlockSpec((1, 1, bq, d),
+                         lambda bi, hi, ki, t: (bi, qhead(hi, t), qblock(t), 0)),
+            pl.BlockSpec((1, 1, bq, LSE_LANES),
+                         lambda bi, hi, ki, t: (bi, qhead(hi, t), qblock(t), 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, t: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, t: (bi, hi, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, hq, sk, d), jnp.float32),
-            jax.ShapeDtypeStruct((b, hq, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, hkv, sk, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(qt, kt, vt, dot, lse, delta)
+    )(qt, kt, vt, dot, outt, lse)
 
-    # sum the GQA group back into kv heads
-    dk = dk_per_h.reshape(b, hkv, group, sk, d).sum(axis=2)
-    dv = dv_per_h.reshape(b, hkv, group, sk, d).sum(axis=2)
     return (
         dq.transpose(0, 2, 1, 3),
-        dk.transpose(0, 2, 1, 3).astype(k.dtype),
-        dv.transpose(0, 2, 1, 3).astype(v.dtype),
+        dk.transpose(0, 2, 1, 3),
+        dv.transpose(0, 2, 1, 3),
     )
 
 

@@ -90,7 +90,7 @@ class MeshConfig:
 
 
 def create_mesh(config=None, devices=None):
-    """Build a 4-axis ``jax.sharding.Mesh`` over the available devices."""
+    """Build the 6-axis ``jax.sharding.Mesh`` over the available devices."""
     if config is None:
         config = MeshConfig()
     if devices is None:

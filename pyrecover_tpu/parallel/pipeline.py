@@ -21,9 +21,13 @@ explicit 1F1B event loops), the whole pipeline is ONE jitted SPMD program:
     exactly GPipe's dataflow.
 
 Bubble fraction is the textbook ``(S-1)/(M+S-1)``; raise ``n_microbatches``
-to amortize. Peak activation memory per stage is ``M/S`` of the full batch's
-(all microbatches are in flight, GPipe-style); combine with block remat
-(``ModelConfig.remat``) for long sequences.
+to amortize. What PP shards is the *parameters and optimizer state* (each
+stage holds L/S layers); the microbatch input/output buffers are currently
+replicated across stages (``in_specs``/``out_specs`` of ``P()``) and the
+tick scan keeps all microbatches live GPipe-style, so per-stage *activation*
+memory does not shrink with S — combine with block remat
+(``ModelConfig.remat``) for long sequences, and use fsdp/sequence axes when
+activations, not parameters, are the limit.
 """
 
 import jax

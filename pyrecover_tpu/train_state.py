@@ -83,6 +83,11 @@ def chunked_ce(params, hidden, labels, model_config, chunk_size):
     h_chunks = jnp.moveaxis(hidden.reshape(b, n, chunk_size, d), 1, 0)
     l_chunks = jnp.moveaxis(labels.reshape(b, n, chunk_size), 1, 0)
 
+    # remat per chunk: without it the scanned backward SAVES each chunk's
+    # f32 logits/logprobs — i.e. the full (b, s, vocab) cost the chunking
+    # exists to avoid (observed: +8G HBM at the 1B bench point). Recompute
+    # is one extra (chunk, d)x(d, vocab) matmul per chunk.
+    @jax.checkpoint
     def per_chunk(args):
         h, lab = args
         logits = project_vocab(params, h, model_config)
