@@ -147,8 +147,12 @@ def main():
     tokens = args.steps * args.batch_size * args.seq_len
     tok_per_sec = tokens / dt
     tok_per_sec_chip = tok_per_sec / n_devices
+    from pyrecover_tpu.models.presets import analytic_active_param_count
+
+    # MoE: FLOPs/token counts only the top-k active experts
+    n_params_active = analytic_active_param_count(model_cfg)
     flop_per_token = get_num_flop_per_token(
-        n_params, model_cfg.n_layers, model_cfg.n_heads,
+        n_params_active, model_cfg.n_layers, model_cfg.n_heads,
         model_cfg.head_dim, args.seq_len,
     )
     peak = tpu_peak_flops()

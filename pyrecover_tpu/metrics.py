@@ -48,6 +48,10 @@ class ThroughputMeter:
     """Windowed tokens/sec + MFU accounting between logging points."""
 
     def __init__(self, model_config, num_params, seq_len, n_devices=None):
+        from pyrecover_tpu.models.presets import inactive_expert_param_count
+
+        # MoE: only the top-k active experts' FLOPs count toward MFU
+        num_params -= inactive_expert_param_count(model_config)
         self.flop_per_token = get_num_flop_per_token(
             num_params,
             model_config.n_layers,

@@ -49,11 +49,23 @@ def moe_8x1b(max_seq_len=2048, vocab_size=32768):
     )
 
 
+def moe_8x150m(max_seq_len=1024, vocab_size=32768):
+    """Single-chip-sized MoE (0.52B params, 0.18B active): the llama-150m
+    backbone with 8 top-2 experts — fits one 16G chip for MoE benchmarking."""
+    return ModelConfig(
+        dim=768, n_layers=12, n_heads=12, n_kv_heads=4,
+        ffn_dim_multiplier=1.0, multiple_of=256, rope_theta=500000.0,
+        vocab_size=vocab_size, max_seq_len=max_seq_len,
+        n_experts=8, moe_top_k=2,
+    )
+
+
 PRESETS = {
     "llama-8b": llama_8b,
     "llama-1b": llama_1b,
     "llama-150m": llama_150m,
     "moe-8x1b": moe_8x1b,
+    "moe-8x150m": moe_8x150m,
 }
 
 
@@ -79,6 +91,22 @@ def analytic_param_count(cfg):
         + cfg.dim
         + cfg.dim * cfg.vocab_size
     )
+
+
+def inactive_expert_param_count(cfg):
+    """Parameters NOT touched per token: the (E - top_k) unused experts'
+    FFN weights per layer. 0 for dense models. Subtract from any param
+    count (analytic or measured) before feeding the 6N FLOPs/token model
+    (reference utils.py:41-56) — otherwise MoE MFU is overstated by ~E/k."""
+    if cfg.n_experts <= 0:
+        return 0
+    unused = cfg.n_experts - cfg.moe_top_k
+    return cfg.n_layers * unused * 3 * cfg.dim * cfg.expert_hidden_dim
+
+
+def analytic_active_param_count(cfg):
+    """Parameters touched per token (see inactive_expert_param_count)."""
+    return analytic_param_count(cfg) - inactive_expert_param_count(cfg)
 
 
 if __name__ == "__main__":
