@@ -149,8 +149,12 @@ def main():
     tok_per_sec_chip = tok_per_sec / n_devices
     from pyrecover_tpu.models.presets import analytic_active_param_count
 
-    # MoE: FLOPs/token counts only the top-k active experts
-    n_params_active = analytic_active_param_count(model_cfg)
+    # MoE: FLOPs/token counts only the top-k active experts.
+    # exclude_embedding: the reference's 6N convention drops the token
+    # embedding table (train.py:126-127); the untied output proj stays.
+    n_params_active = analytic_active_param_count(
+        model_cfg, exclude_embedding=True
+    )
     flop_per_token = get_num_flop_per_token(
         n_params_active, model_cfg.n_layers, model_cfg.n_heads,
         model_cfg.head_dim, args.seq_len,
@@ -167,6 +171,7 @@ def main():
         "batch_size": args.batch_size,
         "step_time_s": round(dt / args.steps, 4),
         "mfu_pct": round(mfu * 100, 2),
+        "mfu_convention": "6N excludes token embedding (ref train.py:126-127)",
         "tflops_per_chip": round(flop_per_token * tok_per_sec_chip / 1e12, 2),
     }
 
@@ -206,6 +211,11 @@ def main():
             shutil.rmtree(tmp, ignore_errors=True)
 
     reference_mfu = 0.35  # see module docstring
+    extra["vs_baseline_assumption"] = (
+        "ASSUMED reference MFU 0.35 (typical DDP+flash ~1B on H100-class; "
+        "the reference publishes no numbers — BASELINE.json's published "
+        "section is empty)"
+    )
     print(json.dumps({
         "metric": "tokens_per_sec_per_chip",
         "value": round(tok_per_sec_chip, 1),

@@ -138,7 +138,16 @@ def save_ckpt_vanilla(path, state, sampler_state=None, *, verify=False,
     sync_global_devices("vanilla_save_enter")
 
     path_leaves, treedef = jax.tree_util.tree_flatten_with_path(state)
-    np_leaves = [_leaf_to_numpy(x) for _, x in path_leaves]  # allgather on ALL hosts
+    # Sharded leaves are allgathered (a collective: every host participates),
+    # but only host 0 KEEPS the numpy copies — non-zero hosts drop each leaf
+    # as soon as the gather returns, bounding their extra host RAM to one
+    # leaf instead of the full state (~full-model × fp32 per host at 8B).
+    is_host0 = jax.process_index() == 0
+    np_leaves = []
+    for _, x in path_leaves:
+        arr = _leaf_to_numpy(x)
+        np_leaves.append(arr if is_host0 else None)
+        del arr
     keystrs = [jax.tree_util.keystr(p) for p, _ in path_leaves]
 
     if background:
@@ -243,12 +252,18 @@ def load_ckpt_vanilla(path, target_state, *, verify=False):
 
     Every host reads the file; each leaf is ``device_put`` onto the
     corresponding target leaf's sharding (resharding onto any topology —
-    SURVEY hard-part #2's load half). Checksum verification runs in a
+    SURVEY hard-part #2's load half). Multi-host reads are STAGGERED by
+    ``PYRECOVER_LOAD_STAGGER_S`` seconds × process index (default 3 s, the
+    reference's per-rank stagger, checkpoint.py:139-141) so a pod doesn't
+    stampede one shared filesystem. Checksum verification runs in a
     background thread overlapping deserialization (reference
     checkpoint.py:151-178). Returns (state, sampler_state, meta).
     """
     path = Path(path)
     sync_global_devices("vanilla_load_enter")
+    if jax.process_count() > 1 and jax.process_index() > 0:
+        stagger = float(os.environ.get("PYRECOVER_LOAD_STAGGER_S", "3"))
+        time.sleep(min(stagger * jax.process_index(), 60.0))
 
     verify_error = []
     verify_thread = None

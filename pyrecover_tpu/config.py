@@ -11,7 +11,9 @@ aliases so reference launch lines keep working:
   * ``--fused-optimizer`` / ``--compile`` → accepted no-ops (XLA always
     compiles and fuses the optimizer into the step).
   * ``--use_flash_attention`` → selects the Pallas flash-attention kernel.
-  * ``--distributed`` → accepted; the mesh is sized from visible devices.
+  * ``--distributed`` → requires a multi-host env: a failed or absent
+    rendezvous is FATAL (reference dist_utils.py:64-65 exits hard), never
+    a silent fall-back to N divergent single-process runs.
 """
 
 import argparse
@@ -50,7 +52,7 @@ class TrainConfig:
     loss_chunk_size: int = 0  # >0: fused chunked CE, never materializes full logits
     # -- parallelism ---------------------------------------------------------
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
-    distributed: bool = False  # accepted for parity; mesh is always used
+    distributed: bool = False  # demand a multi-host rendezvous (hard-fail without one)
     # -- checkpointing -------------------------------------------------------
     checkpoint_dir: str = "checkpoints/"
     checkpoint_frequency: int = 10  # -1 disables (reference utils.py semantics)
@@ -65,6 +67,9 @@ class TrainConfig:
     default_iter_time: float = 1.0
     default_ckpt_time: float = 10.0
     job_end_time: Optional[float] = None  # unix seconds; else $JOB_END_TIME / SLURM_JOB_END_TIME
+    # deadline/notice checks (device sync + cross-host broadcast) run every
+    # k-th step; the safety buffer absorbs the ≤(k-1)-step decision delay
+    preempt_check_interval: int = 5
     # -- observability -------------------------------------------------------
     logging_frequency: int = 5
     log_loss_to_csv: bool = False
@@ -143,7 +148,10 @@ def build_parser():
                         "fusing the vocab projection (HBM saver for big vocabs).")
 
     # parallelism (new; the reference's --distributed has no shape control)
-    p.add_argument("--distributed", action="store_true")
+    p.add_argument("--distributed", action="store_true",
+                   help="Require multi-host rendezvous; hard-fail if the "
+                        "cluster env is absent or unreachable "
+                        "(reference dist_utils.py:64-65).")
     p.add_argument("--dp", type=int, default=d.mesh.data, help="data-parallel axis size; -1 = all remaining")
     p.add_argument("--fsdp", type=int, default=d.mesh.fsdp)
     p.add_argument("--tp", type=int, default=d.mesh.tensor)
@@ -174,6 +182,10 @@ def build_parser():
     p.add_argument("--default-ckpt-time", type=float, default=d.default_ckpt_time)
     p.add_argument("--job-end-time", type=float, default=None,
                    help="Unix seconds; default from $JOB_END_TIME or $SLURM_JOB_END_TIME.")
+    p.add_argument("--preempt-check-interval", type=int,
+                   default=d.preempt_check_interval,
+                   help="Run the deadline/notice check (device sync + cross-"
+                        "host broadcast) every k-th step instead of every step.")
 
     # observability (utils.py:152-170, 249-254)
     p.add_argument("--logging-frequency", type=int, default=d.logging_frequency)
@@ -234,6 +246,7 @@ def get_args(argv=None):
         default_iter_time=ns.default_iter_time,
         default_ckpt_time=ns.default_ckpt_time,
         job_end_time=ns.job_end_time,
+        preempt_check_interval=ns.preempt_check_interval,
         logging_frequency=ns.logging_frequency,
         log_loss_to_csv=ns.log_loss_to_csv,
         profile=ns.profile,

@@ -19,9 +19,20 @@ from pyrecover_tpu.utils.perf import get_num_flop_per_token, tpu_peak_flops
 
 
 class LossCSVLogger:
-    """Rank-0 per-step (step, loss) CSV (reference train.py:143-151, 277-280)."""
+    """Rank-0 per-step (step, loss) CSV (reference train.py:143-151, 277-280).
 
-    def __init__(self, exp_dir, experiment_name, enabled=True):
+    ``resume_step`` (the checkpoint step resumed from, > 0) appends to an
+    existing CSV instead of truncating it, so an interrupt/resume cycle
+    yields ONE continuous loss curve — the very artifact
+    ``tools/compare_loss_csv.py`` exists to compare. (The reference
+    truncates on every start, train.py:143-151 — destroying the pre-resume
+    segment.) Rows PAST the resume point are dropped first: a kill between
+    the last checkpoint and the last logged step would otherwise leave
+    steps duplicated with diverging losses when the resumed run replays
+    them.
+    """
+
+    def __init__(self, exp_dir, experiment_name, enabled=True, resume_step=0):
         self.enabled = enabled and jax.process_index() == 0
         self._file = None
         self._writer = None
@@ -29,9 +40,19 @@ class LossCSVLogger:
             exp_dir = Path(exp_dir)
             exp_dir.mkdir(parents=True, exist_ok=True)
             path = exp_dir / f"{experiment_name}_loss_log.csv"
-            self._file = open(path, "w", newline="")
+            append = resume_step > 0 and path.exists() and path.stat().st_size > 0
+            if append:
+                with open(path, newline="") as f:
+                    rows = list(csv.reader(f))
+                kept = [rows[0]] + [
+                    r for r in rows[1:] if r and int(r[0]) <= resume_step
+                ]
+                with open(path, "w", newline="") as f:
+                    csv.writer(f).writerows(kept)
+            self._file = open(path, "a" if append else "w", newline="")
             self._writer = csv.writer(self._file)
-            self._writer.writerow(["step", "loss"])
+            if not append:
+                self._writer.writerow(["step", "loss"])
 
     def log(self, step, loss):
         if self._writer is not None:

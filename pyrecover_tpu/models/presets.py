@@ -60,19 +60,39 @@ def moe_8x150m(max_seq_len=1024, vocab_size=32768):
     )
 
 
+def moe_4x1b(max_seq_len=1024, vocab_size=32768):
+    """Chip-sized MoE at MXU-viable width (≈1.8B params, ≈1.0B active):
+    the llama-1b backbone's dim 2048 / ffn 7168 with 8 layers of 4 top-2
+    experts. The 768-wide moe-8x150m is VPU/HBM-limited (a D=768 matmul
+    tops out near 45% of v5e peak — measured, see PARITY.md), so this
+    preset is where active-param MFU meaningfully measures the MoE path."""
+    return ModelConfig(
+        dim=2048, n_layers=8, n_heads=16, n_kv_heads=8,
+        ffn_dim_multiplier=1.3, multiple_of=1024, rope_theta=500000.0,
+        vocab_size=vocab_size, max_seq_len=max_seq_len,
+        n_experts=4, moe_top_k=2,
+    )
+
+
 PRESETS = {
     "llama-8b": llama_8b,
     "llama-1b": llama_1b,
     "llama-150m": llama_150m,
     "moe-8x1b": moe_8x1b,
     "moe-8x150m": moe_8x150m,
+    "moe-4x1b": moe_4x1b,
 }
 
 
-def analytic_param_count(cfg):
+def analytic_param_count(cfg, exclude_embedding=False):
     """Closed-form parameter count (no initialization needed) — the
     capability of the reference's model smoke test (test_model.py:6-25),
-    which instantiates the full 8B model just to count."""
+    which instantiates the full 8B model just to count.
+
+    ``exclude_embedding`` drops the token-embedding table (the reference's
+    FLOPs-accounting convention, train.py:126-127); the untied output
+    projection stays, as it does in the reference.
+    """
     hd = cfg.head_dim
     per_layer = (
         2 * cfg.dim
@@ -85,8 +105,9 @@ def analytic_param_count(cfg):
         per_layer += cfg.n_experts * 3 * cfg.dim * cfg.expert_hidden_dim
     else:
         per_layer += 3 * cfg.dim * cfg.ffn_hidden_dim
+    embed = 0 if exclude_embedding else cfg.vocab_size * cfg.dim
     return (
-        cfg.vocab_size * cfg.dim
+        embed
         + cfg.n_layers * per_layer
         + cfg.dim
         + cfg.dim * cfg.vocab_size
@@ -104,9 +125,12 @@ def inactive_expert_param_count(cfg):
     return cfg.n_layers * unused * 3 * cfg.dim * cfg.expert_hidden_dim
 
 
-def analytic_active_param_count(cfg):
+def analytic_active_param_count(cfg, exclude_embedding=False):
     """Parameters touched per token (see inactive_expert_param_count)."""
-    return analytic_param_count(cfg) - inactive_expert_param_count(cfg)
+    return (
+        analytic_param_count(cfg, exclude_embedding=exclude_embedding)
+        - inactive_expert_param_count(cfg)
+    )
 
 
 if __name__ == "__main__":

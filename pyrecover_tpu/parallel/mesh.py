@@ -120,22 +120,39 @@ def _filter_spec_for_mesh(spec, axis_names):
     return P(*out)
 
 
+def nonmanual_axes(mesh):
+    """Mesh axis names NOT currently bound manually (i.e. usable in sharding
+    constraints). Inside a ``shard_map`` the manual axes are implicit — a
+    constraint naming them would error."""
+    types = getattr(mesh, "axis_types", None)
+    if types is None:
+        return set(mesh.axis_names)
+    from jax.sharding import AxisType
+
+    return {
+        n for n, t in zip(mesh.axis_names, types) if t != AxisType.Manual
+    }
+
+
 def constrain(x, *spec):
     """``with_sharding_constraint`` that is a no-op outside a mesh context.
 
     Model code calls ``constrain(x, 'data', None, 'tensor')`` unconditionally;
     under ``jax.sharding.set_mesh`` (or an in-scope concrete mesh) the
     constraint is applied, otherwise the value passes through untouched so
-    the same model runs single-device.
+    the same model runs single-device. Axes that are missing from the mesh
+    OR manually bound by an enclosing ``shard_map`` are dropped from the
+    spec, so the same model code also runs inside manual regions.
     """
     mesh = jax.sharding.get_abstract_mesh()
     if mesh is None or mesh.empty:
         return x
-    filtered = _filter_spec_for_mesh(spec, set(mesh.axis_names))
+    filtered = _filter_spec_for_mesh(spec, nonmanual_axes(mesh))
     return jax.lax.with_sharding_constraint(x, filtered)
 
 
-def initialize_distributed(coordinator_address=None, num_processes=None, process_id=None):
+def initialize_distributed(coordinator_address=None, num_processes=None,
+                           process_id=None, required=False):
     """Multi-host init: the TPU-native `maybe_init_distributed`
     (reference `dist_utils.py:38-68`).
 
@@ -143,6 +160,12 @@ def initialize_distributed(coordinator_address=None, num_processes=None, process
     runtime, so a bare ``jax.distributed.initialize()`` suffices; explicit
     args are accepted for non-TPU clusters (the SLURM-env analogue).
     No-op when running single-process.
+
+    Failure policy (reference `dist_utils.py:64-65` exits hard when
+    ``--distributed`` is set without a usable env): once a cluster env is
+    detected — or ``required=True`` — a failed rendezvous RAISES. Falling
+    back to single-process silently would have every pod host train a
+    divergent solo run and clobber each other's checkpoints.
     """
     # IMPORTANT: don't touch jax.devices()/process_count() here — that would
     # initialize the local backend and make distributed init impossible.
@@ -172,14 +195,24 @@ def initialize_distributed(coordinator_address=None, num_processes=None, process
             w for w in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if w
         ]
         if not coord and len(workers) <= 1:
+            if required:
+                raise RuntimeError(
+                    "--distributed requested but no cluster environment "
+                    "found: set COORDINATOR_ADDRESS/JAX_COORDINATOR_ADDRESS "
+                    "or run under a TPU pod runtime (TPU_WORKER_HOSTNAMES). "
+                    "Refusing to fall back to single-process (reference "
+                    "dist_utils.py:64-65)."
+                )
             return
     try:
         jax.distributed.initialize(**kwargs)
-    except (ValueError, RuntimeError):
-        # Cluster env looked present but init failed (e.g. single-host TPU
-        # VM) — run single-process, mirroring the reference's maybe_*
-        # tolerance.
-        pass
+    except (ValueError, RuntimeError) as e:
+        # A cluster env WAS detected (or explicitly given): failing half-way
+        # must stop the job, not degrade it to N divergent solo runs.
+        raise RuntimeError(
+            f"distributed rendezvous failed ({e}); refusing to continue "
+            "single-process with a cluster environment present"
+        ) from e
 
 
 def sync_global_devices(tag="barrier"):

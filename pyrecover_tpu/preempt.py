@@ -60,11 +60,15 @@ class PreemptionWatcher:
 
     def __init__(self, *, enabled, default_iter_time=1.0,
                  default_ckpt_time=10.0, job_end_time=None,
-                 notice_file=None):
+                 notice_file=None, check_interval=1):
         self.enabled = enabled
         self.job_end_time = get_job_end_time(job_end_time)
         self.max_iter_time = float(default_iter_time)
         self.max_ckpt_time = float(default_ckpt_time)
+        # the deadline/notice check runs every k-th step (a forced device
+        # sync + cross-host broadcast would otherwise tax EVERY step); the
+        # threshold absorbs the ≤(k-1)-step decision delay
+        self.check_interval = max(1, int(check_interval))
         notice = notice_file or os.environ.get(PREEMPT_NOTICE_ENV)
         self.notice_file = Path(notice) if notice else None
         self._signal_seen = False
@@ -114,11 +118,22 @@ class PreemptionWatcher:
             return True
         return self.notice_file is not None and self.notice_file.exists()
 
-    # -- the per-step decision (host 0 decides, all hosts agree) --------------
-    def should_stop(self):
-        """Called once per step. Returns True on every host when it is time
-        to take the final checkpoint and exit."""
+    # -- the periodic decision (host 0 decides, all hosts agree) --------------
+    def is_check_step(self, step):
+        """True on the steps where ``should_stop`` actually checks. Driven by
+        the global step counter, so every host agrees on which steps carry
+        the collective — the broadcast count stays identical across hosts."""
+        return self.enabled and step % self.check_interval == 0
+
+    def should_stop(self, step=None):
+        """Called once per step (pass the global step). Runs the real check —
+        device-visible deadline math + a cross-host broadcast — only every
+        ``check_interval``-th step; other steps return False with zero
+        device/host traffic. Returns True on every host when it is time to
+        take the final checkpoint and exit."""
         if not self.enabled:
+            return False
+        if step is not None and not self.is_check_step(step):
             return False
         decision = False
         reason = None
@@ -127,7 +142,12 @@ class PreemptionWatcher:
             reason = "preemption notice received"
         elif self.job_end_time is not None:
             time_left = self.job_end_time - time.time()
-            threshold = self.max_iter_time + self.max_ckpt_time + self.safety_buffer
+            # up to (check_interval-1) more steps run before the next check
+            threshold = (
+                self.check_interval * self.max_iter_time
+                + self.max_ckpt_time
+                + self.safety_buffer
+            )
             if time_left < threshold:
                 decision = True
                 reason = (

@@ -99,8 +99,19 @@ def build_dataset(config):
 
 def train(config: TrainConfig):
     init_logger()
-    initialize_distributed()
+    # --distributed makes a failed/absent rendezvous fatal (reference
+    # dist_utils.py:64-65) instead of degrading to N divergent solo runs
+    initialize_distributed(required=config.distributed)
     totals = WallTimeTotals()
+
+    # refuse a checkpoint "dir" that exists as a file (reference train.py:138-139)
+    from pathlib import Path as _Path
+
+    ckpt_root = _Path(config.checkpoint_dir)
+    if ckpt_root.exists() and not ckpt_root.is_dir():
+        raise NotADirectoryError(
+            f"--checkpoint-dir {ckpt_root} exists and is not a directory"
+        )
 
     mesh = create_mesh(config.mesh)
     log_host0(
@@ -211,45 +222,69 @@ def train(config: TrainConfig):
     ).start()
 
     step_fn = make_train_step(model_config, optimizer, loss_chunk_size=config.loss_chunk_size)
+    # MFU/TFLOPs use the reference's 6N convention: token embedding excluded
+    # (ref train.py:126-127), untied output projection kept.
     meter = ThroughputMeter(
-        model_config, n_params, config.sequence_length, jax.device_count()
+        model_config,
+        get_num_params(state.params, exclude_embedding=True),
+        config.sequence_length,
+        jax.device_count(),
     )
     csv_logger = LossCSVLogger(exp_dir, config.experiment_name,
-                               enabled=config.log_loss_to_csv)
+                               enabled=config.log_loss_to_csv,
+                               resume_step=start_step)
     watcher = PreemptionWatcher(
         enabled=config.timeaware_checkpointing,
         default_iter_time=config.default_iter_time,
         default_ckpt_time=config.default_ckpt_time,
         job_end_time=config.job_end_time,
+        check_interval=config.preempt_check_interval,
     ).install_signal_handler()
 
     # ---- hot loop (reference train.py:220-379) -----------------------------
+    # Device syncs (materializing the loss) and the cross-host stop broadcast
+    # run only on logging/CSV/preempt-check steps — every other step is pure
+    # async dispatch, so time-aware mode no longer taxes the hot path.
+    # ``pending_tokens`` holds the per-step n_tokens device scalars between
+    # syncs (tiny arrays; materialized in one batch at the next sync point).
     step = start_step
     stopped_early = False
     train_t0 = time.monotonic()
     profiling = False
+    pending_tokens = []
+    sync_t0 = time.monotonic()
+    steps_since_sync = 0
     with jax.sharding.set_mesh(mesh):
         while step < config.training_steps:
             if config.profile and step == config.profile_step_start and not profiling:
                 jax.profiler.start_trace(config.profile_dir)
                 profiling = True
 
-            iter_t0 = time.monotonic()
             epoch, batch = next(loader)
             state, metrics = step_fn(state, batch)
             step += 1
+            steps_since_sync += 1
+            pending_tokens.append(metrics["n_tokens"])
 
+            check_preempt = watcher.is_check_step(step)
             want_log = step % config.logging_frequency == 0
             want_csv = csv_logger.enabled
-            if want_log or want_csv or config.timeaware_checkpointing:
+            if want_log or want_csv or check_preempt:
                 loss = float(metrics["loss"])  # device sync
-                meter.update(int(metrics["n_tokens"]), config.batch_size)
+                for t in pending_tokens:
+                    meter.update(int(t), config.batch_size)
+                pending_tokens.clear()
                 if want_csv:
                     csv_logger.log(step, loss)
                 if want_log:
                     meter.log(step, epoch, loss)
-            iter_secs = time.monotonic() - iter_t0
-            watcher.observe_iter(iter_secs)
+                # honest per-step time: interval average between sync points
+                # (per-step wall time under async dispatch measures only the
+                # dispatch, except on sync steps where it spikes)
+                now = time.monotonic()
+                watcher.observe_iter((now - sync_t0) / steps_since_sync)
+                sync_t0 = now
+                steps_since_sync = 0
 
             if config.profile and step == config.profile_step_end and profiling:
                 jax.profiler.stop_trace()
@@ -264,9 +299,13 @@ def train(config: TrainConfig):
                 secs = save_ckpt(step)
                 totals.ckpt_save_s += secs
                 watcher.observe_ckpt(secs)
+                # don't attribute checkpoint time to iteration time
+                sync_t0 = time.monotonic()
+                steps_since_sync = 0
 
-            # time-aware stop (reference train.py:223-232, 342-375)
-            if watcher.should_stop():
+            # time-aware stop (reference train.py:223-232, 342-375); the
+            # deadline/broadcast check runs only on check steps
+            if check_preempt and watcher.should_stop(step):
                 secs = save_ckpt(step, final=True)
                 totals.ckpt_save_s += secs
                 stopped_early = True

@@ -9,6 +9,7 @@ from pyrecover_tpu.data import SyntheticTextDataset
 from pyrecover_tpu.data.collate import collate_clm
 from pyrecover_tpu.models import ModelConfig, forward, init_params
 from pyrecover_tpu.train_state import chunked_loss, masked_cross_entropy
+import pytest
 
 CFG = ModelConfig(param_dtype="float32", compute_dtype="float32").tiny(max_seq_len=64, vocab_size=128)
 
@@ -19,6 +20,7 @@ def make_batch():
     return jnp.asarray(batch["inputs"]), jnp.asarray(batch["labels"])
 
 
+@pytest.mark.slow
 def test_chunked_matches_full():
     params = init_params(jax.random.key(0), CFG)
     tokens, labels = make_batch()

@@ -1,0 +1,57 @@
+"""Distributed-init failure policy: a requested-or-detected cluster that
+cannot rendezvous must be FATAL (reference dist_utils.py:64-65), never a
+silent fall-back to N divergent single-process runs; plus the
+checkpoint-dir collision guard (reference train.py:138-139)."""
+
+import pytest
+
+from pyrecover_tpu.parallel.mesh import initialize_distributed
+
+CLUSTER_VARS = (
+    "COORDINATOR_ADDRESS",
+    "JAX_COORDINATOR_ADDRESS",
+    "TPU_WORKER_HOSTNAMES",
+)
+
+
+def _clear_cluster_env(monkeypatch):
+    for var in CLUSTER_VARS:
+        monkeypatch.delenv(var, raising=False)
+
+
+def test_required_without_cluster_env_raises(monkeypatch):
+    _clear_cluster_env(monkeypatch)
+    with pytest.raises(RuntimeError, match="no cluster environment"):
+        initialize_distributed(required=True)
+
+
+def test_detected_cluster_env_failed_rendezvous_raises(monkeypatch):
+    """Env names a >1-host cluster, but there is nothing to rendezvous with:
+    must raise, not silently continue single-process."""
+    _clear_cluster_env(monkeypatch)
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host-a,host-b")
+    # initialize() without a coordinator in this env fails fast
+    with pytest.raises(RuntimeError, match="rendezvous failed"):
+        initialize_distributed()
+
+
+def test_unrequired_without_cluster_env_is_noop(monkeypatch):
+    _clear_cluster_env(monkeypatch)
+    initialize_distributed()  # plain single-process: no-op, no raise
+
+
+def test_ckpt_dir_collision_guard(tmp_path):
+    from pyrecover_tpu.config import TrainConfig
+    from pyrecover_tpu.models import ModelConfig
+    from pyrecover_tpu.train import train
+
+    bogus = tmp_path / "ckpts"
+    bogus.write_text("not a directory")
+    cfg = TrainConfig(
+        sequence_length=32, batch_size=2, training_steps=1,
+        checkpoint_dir=str(bogus),
+    )
+    cfg.model = ModelConfig().tiny()
+    cfg.__post_init__()
+    with pytest.raises(NotADirectoryError):
+        train(cfg)

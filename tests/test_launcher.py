@@ -11,6 +11,8 @@ from pathlib import Path
 
 import pytest
 
+pytestmark = pytest.mark.slow  # driver/cluster-scale suite; fast tier skips it
+
 REPO = Path(__file__).resolve().parent.parent
 SCRIPT = REPO / "launch" / "run_resilient.sh"
 

@@ -79,6 +79,23 @@ def test_analytic_param_count_matches_init():
     assert analytic_param_count(cfg) == get_num_params(params)
 
 
+def test_analytic_count_exclude_embedding():
+    """The MFU 6N convention drops tok_embed but keeps the untied output
+    projection (reference train.py:126-127)."""
+    from pyrecover_tpu.models.presets import (
+        analytic_active_param_count,
+        analytic_param_count,
+    )
+
+    cfg = ModelConfig().tiny()
+    total = analytic_param_count(cfg)
+    no_embed = analytic_param_count(cfg, exclude_embedding=True)
+    assert total - no_embed == cfg.vocab_size * cfg.dim
+    assert (
+        analytic_active_param_count(cfg, exclude_embedding=True) == no_embed
+    )
+
+
 def test_preset_8b_matches_reference_size():
     """The llama-8b preset must land at the reference's ≈8.05B params
     (SURVEY §2: dim 4096 × 32L, GQA 32/8, FFN 14336, vocab 131072)."""

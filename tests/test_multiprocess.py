@@ -16,6 +16,9 @@ import sys
 from pathlib import Path
 
 import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # driver/cluster-scale suite; fast tier skips it
 
 REPO = Path(__file__).resolve().parent.parent
 WORKER = Path(__file__).resolve().parent / "_dist_worker.py"
@@ -33,6 +36,7 @@ def test_two_process_mesh(tmp_path):
     env["JAX_PLATFORMS"] = "cpu"
     env["PALLAS_AXON_POOL_IPS"] = ""
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYRECOVER_LOAD_STAGGER_S"] = "0.2"  # exercise the stagger, fast
     env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
     env.pop("_PYRECOVER_TPU_TEST_ENV", None)
 

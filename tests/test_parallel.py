@@ -20,6 +20,8 @@ from pyrecover_tpu.parallel.mesh import MeshConfig, constrain, create_mesh
 from pyrecover_tpu.parallel.sharding import batch_pspec, param_pspecs
 from pyrecover_tpu.train import init_sharded_state, state_pspecs
 
+pytestmark = pytest.mark.slow  # driver/cluster-scale suite; fast tier skips it
+
 MODEL_CFG = ModelConfig().tiny(max_seq_len=32, vocab_size=128)
 TRAIN_CFG = TrainConfig(sequence_length=32, batch_size=8, learning_rate=1e-3)
 

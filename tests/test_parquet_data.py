@@ -69,6 +69,7 @@ def test_parquet_wraparound_and_virtual_length(parquet_file):
 
 
 @pytest.mark.skipif(not HAVE_TOKENIZERS, reason="tokenizers not installed")
+@pytest.mark.slow
 def test_training_on_parquet(parquet_file, tmp_path):
     """Full loop over real parquet+tokenizer data (L1 through L5)."""
     import jax

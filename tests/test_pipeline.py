@@ -20,6 +20,8 @@ from pyrecover_tpu.parallel.mesh import MeshConfig, create_mesh
 from pyrecover_tpu.parallel.pipeline import pipeline_blocks
 from pyrecover_tpu.train import init_sharded_state
 
+pytestmark = pytest.mark.slow  # driver/cluster-scale suite; fast tier skips it
+
 MODEL_CFG = ModelConfig().tiny(max_seq_len=32, vocab_size=128, n_layers=4)
 TRAIN_CFG = TrainConfig(sequence_length=32, batch_size=8, learning_rate=1e-3)
 

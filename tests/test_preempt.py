@@ -77,6 +77,34 @@ def test_sigterm_triggers_stop():
     assert w.should_stop()
 
 
+def test_check_interval_gates_the_decision():
+    """Non-check steps return False with NO deadline math/broadcast; check
+    steps run the real decision. The threshold absorbs the ≤(k-1)-step
+    decision delay via check_interval·max_iter."""
+    w = PreemptionWatcher(
+        enabled=True, default_iter_time=1.0, default_ckpt_time=10.0,
+        job_end_time=time.time() - 100, check_interval=5,
+    )
+    assert not w.is_check_step(1) and not w.should_stop(1)
+    assert not w.should_stop(4)
+    assert w.is_check_step(5) and w.should_stop(5)
+    # no step argument → back-compat full check
+    assert w.should_stop()
+
+
+def test_check_interval_widens_threshold():
+    # deadline in 40s; per-step check (interval 1): iter+ckpt+buffer =
+    # 1+10+(5+20)=36 < 40 → keep going; interval 20: 20+10+25=55 > 40 → stop
+    deadline = time.time() + 40.0
+    w1 = PreemptionWatcher(enabled=True, default_iter_time=1.0,
+                           default_ckpt_time=10.0, job_end_time=deadline)
+    assert not w1.should_stop()
+    w20 = PreemptionWatcher(enabled=True, default_iter_time=1.0,
+                            default_ckpt_time=10.0, job_end_time=deadline,
+                            check_interval=20)
+    assert w20.should_stop(20)
+
+
 def test_requeue_and_done_markers(tmp_path):
     write_requeue_marker(tmp_path, done=False)
     assert (tmp_path / REQUEUE_MARKER).exists()

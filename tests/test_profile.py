@@ -2,9 +2,13 @@
 jax.profiler between the configured steps (reference NSYS window,
 train.py:236-239, 377-379 — here it's XProf/TensorBoard format)."""
 
+import pytest
+
 from pyrecover_tpu.config import TrainConfig
 from pyrecover_tpu.models import ModelConfig
 from pyrecover_tpu.train import train
+
+pytestmark = pytest.mark.slow  # driver/cluster-scale suite; fast tier skips it
 
 
 def test_profile_window_writes_trace(tmp_path):

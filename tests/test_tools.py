@@ -17,6 +17,7 @@ from pyrecover_tpu.models import ModelConfig
 from pyrecover_tpu.optim import build_optimizer
 from pyrecover_tpu.train import train
 from pyrecover_tpu.train_state import create_train_state
+import pytest
 
 
 def make_state():
@@ -59,6 +60,7 @@ def test_compare_loss_csv(tmp_path, capsys):
     assert compare_main([str(a), str(tmp_path / "missing.csv")]) == 2
 
 
+@pytest.mark.slow
 def test_resume_loss_curve_matches_straight(tmp_path):
     """The reference's loss-convergence benchmark, end to end: per-step loss
     of interrupted+resumed == straight run, bit-exact, on the resumed range."""

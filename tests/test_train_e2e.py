@@ -14,6 +14,8 @@ from pyrecover_tpu.models import ModelConfig
 from pyrecover_tpu.preempt import DONE_MARKER, REQUEUE_MARKER
 from pyrecover_tpu.train import train
 
+pytestmark = pytest.mark.slow  # driver/cluster-scale suite; fast tier skips it
+
 
 def tiny_config(tmp_path, **overrides):
     base = dict(
@@ -70,6 +72,31 @@ def test_driver_resume_bitexact(tmp_path, sharded, async_ckpt):
     assert end_step == 8 and not stopped
     for a, b in zip(leaves(straight_state), leaves(resumed_state)):
         np.testing.assert_array_equal(a, b)
+
+
+def test_loss_csv_spans_interrupt_resume(tmp_path):
+    """The per-step loss CSV must be ONE continuous curve across an
+    interrupt/resume cycle: the resumed run appends (metrics.py) instead of
+    truncating the pre-resume segment like the reference (train.py:143-151)."""
+    import csv as csvlib
+
+    cfg1 = tiny_config(tmp_path, training_steps=4, log_loss_to_csv=True)
+    train(cfg1)
+    csv_path = tmp_path / "e2e" / "e2e_loss_log.csv"
+    rows = list(csvlib.reader(open(csv_path)))
+    assert [r[0] for r in rows] == ["step", "1", "2", "3", "4"]
+
+    cfg2 = tiny_config(
+        tmp_path, log_loss_to_csv=True, resume_from_checkpoint="latest"
+    )
+    train(cfg2)
+    rows = list(csvlib.reader(open(csv_path)))
+    assert [r[0] for r in rows] == ["step", "1", "2", "3", "4", "5", "6", "7", "8"]
+    # a fresh (non-resume) run still truncates — new experiment, new curve
+    cfg3 = tiny_config(tmp_path, training_steps=2, log_loss_to_csv=True)
+    train(cfg3)
+    rows = list(csvlib.reader(open(csv_path)))
+    assert [r[0] for r in rows] == ["step", "1", "2"]
 
 
 def test_timeaware_stop_and_requeue(tmp_path):

@@ -16,6 +16,8 @@ from pyrecover_tpu.config import TrainConfig
 from pyrecover_tpu.data import DataLoader, StatefulSampler, SyntheticTextDataset
 from pyrecover_tpu.models import ModelConfig
 from pyrecover_tpu.optim import build_optimizer
+import pytest
+
 from pyrecover_tpu.train_state import (
     IGNORE_INDEX,
     create_train_state,
@@ -62,6 +64,7 @@ def test_initial_loss_near_uniform():
     assert abs(loss - np.log(MODEL_CFG.vocab_size)) < 1.0, loss
 
 
+@pytest.mark.slow
 def test_loss_decreases():
     state, step_fn = make_stack()
     loader, _ = make_loader()
@@ -82,6 +85,7 @@ def test_step_counter_and_rng_advance():
     assert not np.array_equal(np.asarray(new_state.rng), np.asarray(state.rng))
 
 
+@pytest.mark.slow
 def test_two_runs_identical():
     """Same seed, same data → bitwise-identical params after N steps."""
 
@@ -98,6 +102,7 @@ def test_two_runs_identical():
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
+@pytest.mark.slow
 def test_bitexact_resume_vanilla(tmp_ckpt_dir):
     """The north-star test: straight N-step run == (k steps → checkpoint →
     fresh process state → restore → N-k steps), EXACTLY."""
