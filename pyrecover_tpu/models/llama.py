@@ -65,6 +65,7 @@ class ModelConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01  # load-balance loss scale
     moe_ffn_hidden: int = 0  # per-expert hidden size; 0 → ffn_hidden_dim
+    moe_dispatch: str = "auto"  # "auto" | "einsum" | "scatter" (see moe.py)
 
     def __post_init__(self):
         if self.n_experts > 0 and self.moe_top_k > self.n_experts:

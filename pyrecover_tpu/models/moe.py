@@ -2,15 +2,22 @@
 
 The reference is dense-only (SURVEY §2.2: "Expert parallel (EP/MoE): No —
 dense SwiGLU only, model.py:233-269"). This is the TPU-native MoE
-construction — einsum-based masked dispatch (Switch-Transformer style)
-rather than scatter/gather token shuffling:
+construction — rank-and-scatter dispatch over static shapes:
 
-  * Routing, capacity masking, and dispatch/combine are all dense einsums
-    over static shapes — exactly what the MXU and XLA's SPMD partitioner
-    want. No dynamic shapes, no sorting networks.
+  * Each (token, top-k slot) pick's capacity-queue position is an
+    exclusive cumsum over a small (B, S·K, E) one-hot in (s, k) flat
+    order — first-come-first-served, no sorting networks. Dispatch is one
+    row scatter-add and combine one row gather — O(S·K·D) data movement.
+    The masked-einsum formulation (Switch-style one-hot (B,S,K,E,C) slot
+    tensors) costs O(S·E·C·D) with C ∝ S — quadratic in sequence length
+    in time AND memory; the rank form leaves the MXU only the real
+    expert FLOPs.
+  * All shapes are static (ranks, fixed capacity C): XLA sees a fixed
+    program regardless of routing; dropped tokens keep a clamped slot but
+    a zeroed payload/gate, so they contribute exactly nothing.
   * Expert-stacked weights ``(E, D, F)`` are sharded on their expert axis
     over the ``expert`` mesh axis; annotating the ``(B, E, C, D)`` expert
-    inputs with the same axis turns the dispatch/combine einsums into
+    inputs with the same axis turns the dispatch/combine transfers into
     all-to-alls over ICI, inserted by the compiler.
   * Each batch row is a routing group: capacity and the load-balance aux
     loss are computed per row, which keeps every statistic local under
@@ -19,6 +26,12 @@ rather than scatter/gather token shuffling:
 
 Top-k routing renormalizes the selected gate probabilities (Mixtral-style);
 the aux loss is the Switch load-balance loss ``E · Σ_e f_e·p_e`` per row.
+
+Two dispatch backends share these semantics (pinned equal by tests):
+``_moe_ffn_impl`` (sort/scatter — the fast path) everywhere GSPMD manages
+the whole mesh, and ``_moe_ffn_einsum`` (masked one-hot einsums) inside
+manual regions (pipeline stages), where the partitioner cannot handle
+batch-sharded index ops. ``moe_ffn`` picks automatically.
 """
 
 import math
@@ -43,6 +56,15 @@ def moe_ffn(h, router_w, w1, w3, w2, config):
     """MoE SwiGLU: route each token to its top-k experts, run the expert
     FFNs at fixed capacity, combine weighted outputs.
 
+    Picks a dispatch backend per context (see module docstring): the
+    masked-einsum form inside manual regions — XLA's SPMD partitioner
+    CHECK-fails (spmd_partitioner_util.cc device-group computation) on
+    gathers whose indices derive from batch-sharded operands there, and
+    einsums are the one form every partitioner handles — otherwise
+    einsum-vs-scatter by the estimated slot-tensor size. In all cases the
+    (B,E,C,D) constrain turns dispatch into all-to-alls over the
+    ``expert`` axis.
+
     Args:
       h: (B, S, D) activations (compute dtype).
       router_w: (D, E) router weights.
@@ -53,10 +75,47 @@ def moe_ffn(h, router_w, w1, w3, w2, config):
       (y, aux): y (B, S, D) same dtype as h; aux (B,) f32 per-row
       load-balance loss (caller scales by ``moe_aux_weight``).
     """
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is not None and not mesh.empty:
+        from pyrecover_tpu.parallel.mesh import nonmanual_axes
+
+        if len(nonmanual_axes(mesh)) != len(mesh.axis_names):
+            # Inside a manual region (the pipeline stage shard_map): XLA's
+            # SPMD partitioner CHECK-fails on gathers whose indices derive
+            # from batch-sharded operands under partial-manual meshes, and
+            # Shardy rejects the nested-shard_map alternative (manual axes
+            # must precede free axes in dim shardings — violated by the AD
+            # residuals of stage-sharded layers). Use the masked-einsum
+            # dispatch there: expressible entirely as einsums, compiles
+            # everywhere, numerically pinned to the scatter path by tests.
+            return _moe_ffn_einsum(h, router_w, w1, w3, w2, config)
+    choice = config.moe_dispatch
+    if choice == "auto":
+        # Measured on v5e (8x150m, S=1024, fwd+bwd per MoE layer): einsum
+        # 5.3 ms vs scatter 7.5 ms — 0/1 dispatch einsums ride the MXU at
+        # near-peak while TPU scatters serialize on the vector units. But
+        # the einsum form's (B,S,K,E,C) slot tensor and O(S·E·C·D) dispatch
+        # FLOPs are quadratic in S (C ∝ S), so past a size threshold the
+        # O(S·K·D) scatter wins. Crossover set where the slot tensor
+        # reaches ~64M elements (≈256 MB f32).
+        B, S = h.shape[0], h.shape[1]
+        C = moe_capacity(
+            S, config.n_experts, config.moe_top_k, config.moe_capacity_factor
+        )
+        slot_elems = B * S * config.moe_top_k * config.n_experts * C
+        choice = "einsum" if slot_elems <= 64 * 1024 * 1024 else "scatter"
+    if choice == "einsum":
+        return _moe_ffn_einsum(h, router_w, w1, w3, w2, config)
+    return _moe_ffn_impl(h, router_w, w1, w3, w2, config)
+
+
+def _moe_ffn_impl(h, router_w, w1, w3, w2, config):
+    """Rank-and-scatter dispatch backend (see module docstring)."""
     cfg = config
     B, S, D = h.shape
     E, K = cfg.n_experts, cfg.moe_top_k
     C = moe_capacity(S, E, K, cfg.moe_capacity_factor)
+    N = S * K
     f32 = jnp.float32
 
     # --- routing (f32 for a stable softmax) ---
@@ -64,34 +123,105 @@ def moe_ffn(h, router_w, w1, w3, w2, config):
     probs = jax.nn.softmax(logits, axis=-1)  # (B,S,E)
     gate_vals, gate_idx = jax.lax.top_k(probs, K)  # (B,S,K)
     gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
-    onehot = jax.nn.one_hot(gate_idx, E, dtype=f32)  # (B,S,K,E)
 
-    # --- capacity assignment: position of each (token, slot) in its
-    # expert's queue, in (s, k) order within the row ---
-    flat = onehot.reshape(B, S * K, E)
-    prio = jnp.cumsum(flat, axis=1) - flat  # 0-based queue position
-    prio = prio.reshape(B, S, K, E)
-    keep = onehot * (prio < C)  # drop overflow tokens
-    slot = jax.nn.one_hot(prio.astype(jnp.int32), C, dtype=f32)  # (B,S,K,E,C)
-    slot = slot * keep[..., None]
-    dispatch = slot.sum(axis=2)  # (B,S,E,C) ∈ {0,1}
-    combine = (slot * gate_vals[..., None, None]).sum(axis=2)  # (B,S,E,C)
+    # --- capacity assignment: each pick's queue position within its expert
+    # is an exclusive cumsum over the small (B,N,E) one-hot in (s, k) flat
+    # order — first-come-first-served, no sort, no C-sized slot tensor ---
+    eids = gate_idx.reshape(B, N)
+    gvals = gate_vals.reshape(B, N)
+    onehot = (
+        eids[:, :, None] == jnp.arange(E, dtype=eids.dtype)[None, None, :]
+    ).astype(jnp.int32)  # (B,N,E)
+    prio = jnp.cumsum(onehot, axis=1) - onehot
+    rank = jnp.sum(prio * onehot, axis=-1)  # (B,N) position in expert queue
+    valid = rank < C
+    # overflow entries: clamp to a real slot but zero their payload — a
+    # scatter-ADD of zeros is a no-op, and in-capacity slots are unique so
+    # add ≡ set. (Out-of-range "drop"/"fill" modes CHECK-fail in XLA's SPMD
+    # partitioner under a partial-manual mesh.)
+    slot = jnp.clip(eids * C + rank, 0, E * C - 1)  # (B,N)
 
-    # --- expert compute at fixed capacity ---
+    # --- dispatch: one row scatter-add, O(S·K·D); the K copies of each
+    # token are a contiguous repeat, not a gather ---
     cdt = h.dtype
-    xin = jnp.einsum("bsec,bsd->becd", dispatch.astype(cdt), h)
+    brange = jnp.arange(B)[:, None]
+    rows = jnp.repeat(h, K, axis=1)  # (B,N,D): entry n ← token n // K
+    rows = rows * valid[..., None].astype(cdt)
+    xin = (
+        jnp.zeros((B, E * C, D), cdt)
+        .at[brange, slot]
+        .add(rows)
+        .reshape(B, E, C, D)
+    )
     xin = constrain(xin, (AXIS_DATA, AXIS_FSDP), AXIS_EXPERT, None, None)
+
+    # --- expert compute at fixed capacity (the real MoE FLOPs) ---
     gate = jax.nn.silu(jnp.einsum("becd,edf->becf", xin, w1.astype(cdt)))
     up = jnp.einsum("becd,edf->becf", xin, w3.astype(cdt))
     out = jnp.einsum("becf,efd->becd", gate * up, w2.astype(cdt))
     out = constrain(out, (AXIS_DATA, AXIS_FSDP), AXIS_EXPERT, None, None)
-    y = jnp.einsum("bsec,becd->bsd", combine.astype(cdt), out)
+    out_flat = out.reshape(B, E * C, D)
+
+    # --- combine: gather each pick's slot result, weight by its gate
+    # (dropped entries read a clamped slot but their gate weight is 0) ---
+    gathered = out_flat[brange, slot]  # (B,N,D)
+    w = jnp.where(valid, gvals, 0.0).astype(cdt)
+    y = jnp.sum((gathered * w[..., None]).reshape(B, S, K, D), axis=2)
 
     # --- Switch load-balance aux loss, per row: E · Σ_e f_e·p_e where
     # f_e = fraction of (token, slot) picks routed to e (pre-capacity;
     # sums to 1 over experts), p_e = mean router probability over the row.
     # Minimized (=1) by a uniform router; spikes when experts collapse. ---
-    f_e = onehot.mean(axis=(1, 2))  # (B,E)
+    f_e = jnp.sum(onehot, axis=1).astype(f32) / N  # (B,E) pre-capacity
     p_e = probs.mean(axis=1)  # (B,E)
     aux = E * jnp.sum(f_e * p_e, axis=-1)  # (B,) f32
+    return y.astype(h.dtype), aux
+
+
+def _moe_ffn_einsum(h, router_w, w1, w3, w2, config):
+    """Masked-einsum (Switch-style one-hot) dispatch: O(S·E·C) memory and
+    mostly-zero MXU work, but expressible entirely as einsums — the form
+    every partitioner handles. Used only inside manual regions (see
+    ``moe_ffn``); semantics are identical to ``_moe_ffn_impl`` (same
+    first-come-first-served capacity in (s, k) flat order, renormalized
+    gates, zero contribution for dropped tokens)."""
+    cfg = config
+    B, S, D = h.shape
+    E, K = cfg.n_experts, cfg.moe_top_k
+    C = moe_capacity(S, E, K, cfg.moe_capacity_factor)
+    f32 = jnp.float32
+
+    logits = jnp.einsum("bsd,de->bse", h.astype(f32), router_w.astype(f32))
+    probs = jax.nn.softmax(logits, axis=-1)  # (B,S,E)
+    gate_vals, gate_idx = jax.lax.top_k(probs, K)  # (B,S,K)
+    gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+    onehot = jax.nn.one_hot(gate_idx, E, dtype=f32)  # (B,S,K,E)
+
+    # queue position of each (token, slot) within its expert, (s, k) order.
+    # The cumsum stays f32 (exact integers), but the big (B,S,K,E,C) slot
+    # one-hot is built directly in the compute dtype: every (e, c) slot has
+    # exactly one contributor, so the K-sums below have no accumulation —
+    # bf16 here is exact 0/1 and halves the VPU traffic on the largest
+    # tensors of the dispatch.
+    cdt = h.dtype
+    flat = onehot.reshape(B, S * K, E)
+    prio = jnp.cumsum(flat, axis=1) - flat  # 0-based queue position
+    prio = prio.reshape(B, S, K, E)
+    keep = (onehot * (prio < C)).astype(cdt)  # drop overflow tokens
+    slot = jax.nn.one_hot(prio.astype(jnp.int32), C, dtype=cdt)  # (B,S,K,E,C)
+    slot = slot * keep[..., None]
+    dispatch = slot.sum(axis=2)  # (B,S,E,C) ∈ {0,1}
+    combine = (slot * gate_vals.astype(cdt)[..., None, None]).sum(axis=2)
+
+    xin = jnp.einsum("bsec,bsd->becd", dispatch, h)
+    xin = constrain(xin, (AXIS_DATA, AXIS_FSDP), AXIS_EXPERT, None, None)
+    gate = jax.nn.silu(jnp.einsum("becd,edf->becf", xin, w1.astype(cdt)))
+    up = jnp.einsum("becd,edf->becf", xin, w3.astype(cdt))
+    out = jnp.einsum("becf,efd->becd", gate * up, w2.astype(cdt))
+    out = constrain(out, (AXIS_DATA, AXIS_FSDP), AXIS_EXPERT, None, None)
+    y = jnp.einsum("bsec,becd->bsd", combine, out)
+
+    f_e = onehot.mean(axis=(1, 2))  # (B,E)
+    p_e = probs.mean(axis=1)  # (B,E)
+    aux = E * jnp.sum(f_e * p_e, axis=-1)
     return y.astype(h.dtype), aux
