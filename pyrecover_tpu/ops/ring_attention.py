@@ -10,9 +10,24 @@ while accumulating an online softmax — compute overlaps the rotation, HBM
 never holds more than one remote chunk, and max context scales linearly
 with the number of devices on the ``sequence`` axis.
 
+Scaling design (this is the v2 the long contexts it exists for need):
+
+  * The ring loop is a ``lax.scan`` — one compiled body regardless of ring
+    size, no unrolled per-step HLO.
+  * The inner update is blockwise (flash-style): the rotating KV chunk is
+    consumed in ``block_kv``-sized sub-blocks under a second ``lax.scan``,
+    so the transient score block is (Sq_local × block_kv) f32 — never the
+    full (Sq_local × Sk_local) matrix.
+  * A custom VJP: the forward saves only (out, LSE) per query — the
+    standard flash-attention residuals — and the backward runs a second
+    ring pass that RECOMPUTES each chunk's scores. dK/dV accumulators
+    rotate with their KV chunks and arrive home after the full ring.
+    Plain AD through the forward would instead retain every rotated KV
+    copy per step (ring × KV memory — exactly what kills long contexts).
+
 Causality is handled with *global* position indices (each device knows its
 ring index via ``lax.axis_index``), so the math is identical to full causal
-attention — verified against the XLA SDPA path in tests.
+attention — verified against the XLA SDPA path in tests (fwd AND grads).
 """
 
 import functools
@@ -23,19 +38,20 @@ from jax.sharding import PartitionSpec as P
 
 from pyrecover_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_TENSOR
 
+_NEG_INF = -1e30
 
-def _local_attention_update(q, k, v, q_start, k_start, scale, causal, m, l, acc):
-    """One online-softmax update of local q against one (possibly remote) KV
-    chunk. Shapes: q (B, Sq, Hkv, G, D); k/v (B, Sk, Hkv, D). State m/l:
-    (B, Hkv, G, Sq, 1); acc: (B, Sq, Hkv, G, D)."""
-    b, sq, hkv, g, d = q.shape
-    sk = k.shape[1]
-    s = jnp.einsum("bqkgd,bskd->bkgqs", q, k,
+
+def _block_update(qg, k, v, q_start, k_start, scale, causal, m, l, acc):
+    """One online-softmax update of local q against one KV sub-block.
+    Shapes: qg (B, Sq, Hkv, G, D); k/v (B, Sk, Hkv, D). State m/l:
+    (B, Hkv, G, Sq, 1) f32; acc: (B, Sq, Hkv, G, D) f32."""
+    sq, sk = qg.shape[1], k.shape[1]
+    s = jnp.einsum("bqkgd,bskd->bkgqs", qg, k,
                    preferred_element_type=jnp.float32) * jnp.float32(scale)
     if causal:
         qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
         kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-        s = jnp.where(qpos >= kpos, s, jnp.float32(-1e30))
+        s = jnp.where(qpos >= kpos, s, jnp.float32(_NEG_INF))
     m_cur = jnp.max(s, axis=-1, keepdims=True)
     m_new = jnp.maximum(m, m_cur)
     p = jnp.exp(s - m_new)
@@ -49,45 +65,217 @@ def _local_attention_update(q, k, v, q_start, k_start, scale, causal, m, l, acc)
     return m_new, l_new, acc_new
 
 
-def _ring_attention_local(q, k, v, *, axis_name, causal, scale):
-    """Per-shard body (runs under shard_map): q/k/v hold THIS device's
-    sequence chunk. Rotates KV around the ring; ``axis_index`` gives the
-    chunk's global offset for exact causal masking."""
+def _split_blocks(x, block):
+    """(B, S, ...) → (nb, B, block, ...) when S divides evenly, else 1 block."""
+    s = x.shape[1]
+    if block and s % block == 0 and s > block:
+        nb = s // block
+        return jnp.moveaxis(
+            x.reshape(x.shape[0], nb, block, *x.shape[2:]), 1, 0
+        )
+    return x[None]
+
+
+def _chunk_update(qg, k, v, q_start, k_start, scale, causal, m, l, acc,
+                  block_kv):
+    """Consume one rotating KV chunk in flash-style sub-blocks (inner scan):
+    the transient score block is (Sq × block_kv), not (Sq × Sk_chunk)."""
+    kb = _split_blocks(k, block_kv)
+    vb = _split_blocks(v, block_kv)
+    blk = kb.shape[2]
+
+    def body(carry, inp):
+        m, l, acc = carry
+        i, kk, vv = inp
+        m, l, acc = _block_update(
+            qg, kk, vv, q_start, k_start + i * blk, scale, causal, m, l, acc
+        )
+        return (m, l, acc), None
+
+    (m, l, acc), _ = jax.lax.scan(
+        body, (m, l, acc), (jnp.arange(kb.shape[0]), kb, vb)
+    )
+    return m, l, acc
+
+
+def _ring_fwd_local(q, k, v, *, axis_name, causal, scale, block_kv):
+    """Per-shard forward (runs under shard_map): q/k/v hold THIS device's
+    sequence chunk. Rotates KV around the ring via a scanned ppermute;
+    returns (out, lse) — lse is the flash-attention residual the backward
+    needs. KV is rotated on every step (incl. the last), so it arrives back
+    home after the scan — the backward relies on the same full rotation."""
     b, sq, hq, d = q.shape
-    sk = k.shape[1]
-    hkv = k.shape[2]
+    sk, hkv = k.shape[1], k.shape[2]
     g = hq // hkv
     ring = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     q_start = my * sq
 
     qg = q.reshape(b, sq, hkv, g, d)
-    m = jnp.full((b, hkv, g, sq, 1), -1e30, dtype=jnp.float32)
-    l = jnp.zeros((b, hkv, g, sq, 1), dtype=jnp.float32)
-    acc = jnp.zeros((b, sq, hkv, g, d), dtype=jnp.float32)
-
+    m0 = jnp.full((b, hkv, g, sq, 1), _NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((b, hkv, g, sq, 1), dtype=jnp.float32)
+    acc0 = jnp.zeros((b, sq, hkv, g, d), dtype=jnp.float32)
     perm = [(i, (i + 1) % ring) for i in range(ring)]
-    k_cur, v_cur = k, v
-    for step in range(ring):
+
+    def ring_step(carry, step):
+        k_cur, v_cur, m, l, acc = carry
         src = (my - step) % ring  # whose chunk we currently hold
-        m, l, acc = _local_attention_update(
-            qg, k_cur, v_cur, q_start, src * sk, scale, causal, m, l, acc
+        m, l, acc = _chunk_update(
+            qg, k_cur, v_cur, q_start, src * sk, scale, causal, m, l, acc,
+            block_kv,
         )
-        if step + 1 < ring:
-            # neighbor exchange over ICI; overlaps with the next update's
-            # compute under XLA's async collective scheduling
-            k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
-            v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        # neighbor exchange over ICI; overlaps the next step's compute
+        # under XLA's async collective scheduling
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_cur, v_cur, m, l, acc), None
+
+    (_, _, m, l, acc), _ = jax.lax.scan(
+        ring_step, (k, v, m0, l0, acc0), jnp.arange(ring)
+    )
 
     l_safe = jnp.where(l > 0, l, 1.0)
-    out = acc / jnp.moveaxis(l_safe, 3, 1)
-    return out.reshape(b, sq, hq, d).astype(q.dtype)
+    out = (acc / jnp.moveaxis(l_safe, 3, 1)).reshape(b, sq, hq, d)
+    lse = m + jnp.log(l_safe)  # (B,Hkv,G,Sq,1)
+    return out.astype(q.dtype), lse
 
 
-def ring_attention(q, k, v, *, causal=True, scale=None, axis_name=AXIS_SEQ):
+def _block_bwd(qg, k, v, do_g, delta, lse, q_start, k_start, scale, causal):
+    """Recompute one KV sub-block's probabilities from (q, k, lse) and
+    return (dq_contrib, dk_block, dv_block) — flash-attention backward
+    algebra."""
+    sq, sk = qg.shape[1], k.shape[1]
+    s = jnp.einsum("bqkgd,bskd->bkgqs", qg, k,
+                   preferred_element_type=jnp.float32) * jnp.float32(scale)
+    if causal:
+        qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(qpos >= kpos, s, jnp.float32(_NEG_INF))
+    p = jnp.exp(s - lse)  # (B,Hkv,G,Sq,Sk); masked entries exp(-inf)=0
+    dv = jnp.einsum("bkgqs,bqkgd->bskd", p, do_g,
+                    preferred_element_type=jnp.float32)
+    dp = jnp.einsum("bqkgd,bskd->bkgqs", do_g, v,
+                    preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * jnp.float32(scale)
+    dq = jnp.einsum("bkgqs,bskd->bqkgd", ds, k,
+                    preferred_element_type=jnp.float32)
+    dk = jnp.einsum("bkgqs,bqkgd->bskd", ds, qg,
+                    preferred_element_type=jnp.float32)
+    return dq, dk, dv
+
+
+def _chunk_bwd(qg, k, v, do_g, delta, lse, q_start, k_start, scale, causal,
+               block_kv):
+    """Backward over one rotating KV chunk in flash-style sub-blocks (inner
+    scan), mirroring ``_chunk_update``: the transient score/prob/ds tensors
+    are (Sq × block_kv) f32 — never the full (Sq × Sk_chunk) matrices,
+    which matters most here because training's memory peak IS the backward."""
+    kb = _split_blocks(k, block_kv)
+    vb = _split_blocks(v, block_kv)
+    nb, blk = kb.shape[0], kb.shape[2]
+
+    def body(dq, inp):
+        i, kk, vv = inp
+        dq_c, dk_b, dv_b = _block_bwd(
+            qg, kk, vv, do_g, delta, lse, q_start, k_start + i * blk, scale,
+            causal,
+        )
+        return dq + dq_c, (dk_b, dv_b)
+
+    dq, (dk_b, dv_b) = jax.lax.scan(
+        body,
+        jnp.zeros(qg.shape, dtype=jnp.float32),
+        (jnp.arange(nb), kb, vb),
+    )
+    # (nb, B, blk, Hkv, D) → (B, Sk_chunk, Hkv, D)
+    dk = jnp.moveaxis(dk_b, 0, 1).reshape(k.shape)
+    dv = jnp.moveaxis(dv_b, 0, 1).reshape(v.shape)
+    return dq, dk, dv
+
+
+def _ring_bwd_local(q, k, v, out, lse, do, *, axis_name, causal, scale,
+                    block_kv):
+    """Second ring pass: dK/dV accumulators travel WITH their KV chunks and
+    are home after the full rotation; dQ accumulates locally."""
+    b, sq, hq, d = q.shape
+    sk, hkv = k.shape[1], k.shape[2]
+    g = hq // hkv
+    ring = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    q_start = my * sq
+
+    qg = q.reshape(b, sq, hkv, g, d)
+    do_g = do.reshape(b, sq, hkv, g, d)
+    # delta_i = Σ_d dO·O per query — (B,Sq,Hq) → (B,Hkv,G,Sq,1)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.moveaxis(
+        delta.reshape(b, sq, hkv, g), (1, 2, 3), (3, 1, 2)
+    )[..., None]
+
+    dq0 = jnp.zeros((b, sq, hkv, g, d), dtype=jnp.float32)
+    dk0 = jnp.zeros((b, sk, hkv, d), dtype=jnp.float32)
+    dv0 = jnp.zeros((b, sk, hkv, d), dtype=jnp.float32)
+    perm = [(i, (i + 1) % ring) for i in range(ring)]
+
+    def ring_step(carry, step):
+        k_cur, v_cur, dk_cur, dv_cur, dq = carry
+        src = (my - step) % ring
+        dq_c, dk_c, dv_c = _chunk_bwd(
+            qg, k_cur, v_cur, do_g, delta, lse, q_start, src * sk, scale,
+            causal, block_kv,
+        )
+        dq = dq + dq_c
+        dk_cur = dk_cur + dk_c
+        dv_cur = dv_cur + dv_c
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_cur = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_cur = jax.lax.ppermute(dv_cur, axis_name, perm)
+        return (k_cur, v_cur, dk_cur, dv_cur, dq), None
+
+    (_, _, dk, dv, dq), _ = jax.lax.scan(
+        ring_step, (k, v, dk0, dv0, dq0), jnp.arange(ring)
+    )
+    return (
+        dq.reshape(b, sq, hq, d).astype(q.dtype),
+        dk.astype(k.dtype),
+        dv.astype(v.dtype),
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_attention_local(q, k, v, axis_name, causal, scale, block_kv):
+    out, _ = _ring_fwd_local(
+        q, k, v, axis_name=axis_name, causal=causal, scale=scale,
+        block_kv=block_kv,
+    )
+    return out
+
+
+def _ring_vjp_fwd(q, k, v, axis_name, causal, scale, block_kv):
+    out, lse = _ring_fwd_local(
+        q, k, v, axis_name=axis_name, causal=causal, scale=scale,
+        block_kv=block_kv,
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _ring_vjp_bwd(axis_name, causal, scale, block_kv, res, do):
+    q, k, v, out, lse = res
+    return _ring_bwd_local(
+        q, k, v, out, lse, do, axis_name=axis_name, causal=causal,
+        scale=scale, block_kv=block_kv,
+    )
+
+
+_ring_attention_local.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
+
+
+def ring_attention(q, k, v, *, causal=True, scale=None, axis_name=AXIS_SEQ,
+                   block_kv=512):
     """Drop-in for ``sdpa_attention``: shards the sequence dimension over the
-    ``sequence`` mesh axis via shard_map + ppermute ring. Falls back to the
-    XLA path when no mesh / a size-1 sequence axis is in scope."""
+    ``sequence`` mesh axis via shard_map + a scanned ppermute ring. Falls
+    back to the XLA path when no mesh / a size-1 sequence axis is in scope."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
 
@@ -102,7 +290,8 @@ def ring_attention(q, k, v, *, causal=True, scale=None, axis_name=AXIS_SEQ):
     spec = P(batch_axes or None, axis_name, head_axis, None)
 
     body = functools.partial(
-        _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale
+        _ring_attention_local, axis_name=axis_name, causal=causal,
+        scale=scale, block_kv=block_kv,
     )
     return jax.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
