@@ -251,6 +251,15 @@ def forward_hidden_with_aux(params, tokens, config):
     attn_fn = _attention_fn(cfg)
 
     x = params["tok_embed"].astype(cdt)[tokens]
+    # Stage the post-gather reshard: the gather's natural output is
+    # model-dim-sharded (the table is (None, tensor×fsdp)); jumping straight
+    # to the batch/seq-sharded activation layout makes GSPMD emit its
+    # "Involuntary full rematerialization" fallback (the tile assignments
+    # are permuted incompatibly). An explicit replicated waypoint turns the
+    # transition into all-gather (dim) + local slice (batch/seq) — the same
+    # bytes, proper collectives, no fallback. Cost: one B·S·D all-gather at
+    # the model entry only.
+    x = constrain(x, None, None, None)
     x = constrain(x, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, None)
 
     block = partial(_block, cos=cos, sin=sin, config=cfg, attn_fn=attn_fn)
