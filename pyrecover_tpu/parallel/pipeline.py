@@ -21,20 +21,43 @@ explicit 1F1B event loops), the whole pipeline is ONE jitted SPMD program:
     exactly GPipe's dataflow.
 
 Bubble fraction is the textbook ``(S-1)/(M+S-1)``; raise ``n_microbatches``
-to amortize. What PP shards is the *parameters and optimizer state* (each
-stage holds L/S layers); the microbatch input/output buffers are currently
-replicated across stages (``in_specs``/``out_specs`` of ``P()``) and the
-tick scan keeps all microbatches live GPipe-style, so per-stage *activation*
-memory does not shrink with S — combine with block remat
-(``ModelConfig.remat``) for long sequences, and use fsdp/sequence axes when
-activations, not parameters, are the limit.
+to amortize.
+
+Memory: PP shards parameters/optimizer state (each stage holds L/S layers)
+AND, when ``M % S == 0`` (always true for the default ``M = S``), the
+microbatch input/output buffers: each stage holds an ``M/S``-slot slice of
+both, and the slices ROTATE one stage per tick over the pipeline ring —
+the input queue rotates toward stage 0 (microbatch ``t`` sits on stage 0
+exactly at tick ``t``), the output queue rotates forward so microbatch
+``m``'s slot passes under stage ``S-1`` exactly at tick ``m+S-1`` when its
+result appears. Per-stage buffer memory is ``2·(M/S)`` microbatches
+instead of ``2·M``, at the cost of ``2·(M/S)`` microbatches of ppermute
+traffic per tick riding ICI neighbor links. When ``M % S != 0`` the
+buffers fall back to replicated (``FORCE_REPLICATED_BUFFERS`` forces the
+same for benchmarking).
+
+Measured honestly (virtual 8-device mesh, remat on, M=32/S=4, compiled
+``memory_analysis``): 266.5 MB temp vs 274.9 MB replicated — a ~3% win,
+not the 2× the buffer arithmetic suggests, because peak temp is dominated
+by the tick scan's AD residuals (one carried microbatch activation per
+tick, ≈ M+S-1 of them), which neither buffer layout touches. Block remat
+(``ModelConfig.remat``) is the lever that shrinks those; the queues bound
+the buffer term so it never becomes the limit as M grows.
 """
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from pyrecover_tpu.parallel.mesh import AXIS_PIPE
+
+
+# Testing/benchmark escape hatch: force the pre-v2 replicated microbatch
+# buffers even when M % S == 0 (used to measure the queue path's memory win).
+# Read at TRACE time — callers flipping it must re-jit (a cached executable
+# keeps whichever layout it was traced with).
+FORCE_REPLICATED_BUFFERS = False
 
 
 def pipeline_axis_size():
@@ -106,18 +129,78 @@ def pipeline_blocks(layer_params, x, block_fn, n_microbatches=0):
     def from_io(tree):
         return tmap(lambda l, dt: l.astype(dt), tree, orig_dtypes)
 
-    def stage_program(local_layers, mbs):
+    sharded_queues = M % S == 0 and not FORCE_REPLICATED_BUFFERS
+    T = M + S - 1  # total ticks
+
+    def local_stack(c, local_layers):
+        # run this stage's (L/S, ...) layer slice over one microbatch
+        def body(c, layer):
+            return block_fn(c, layer), None
+
+        out, _ = jax.lax.scan(body, from_io(c), local_layers)
+        return tmap(to_io, out)
+
+    def stage_program_queued(local_layers, inq):
         # local_layers: (L/S, ...) slice on this stage
-        # mbs: leaves (M, b/M, ...), replicated over the pipeline axis
+        # inq: leaves (M/S, b/M, ...) — this stage's slice of the input
+        #      queue; slot j on stage s holds microbatch j*S + s at t=0
+        s = jax.lax.axis_index(AXIS_PIPE)
+        fwd = [(i, i + 1) for i in range(S - 1)]  # activation chain
+        ring_fwd = [(i, (i + 1) % S) for i in range(S)]
+        ring_back = [(i, (i - 1) % S) for i in range(S)]
+
+        def tick(state, t):
+            carry, inq, outq = state
+            # stage 0 consumes microbatch t, which the backward rotation
+            # has brought to its local slot t // S
+            inp = tmap(
+                lambda q: jax.lax.dynamic_index_in_dim(
+                    q, jnp.clip(t // S, 0, M // S - 1), 0, keepdims=False
+                ),
+                inq,
+            )
+            carry = tmap(
+                lambda i, c: jnp.where(s == 0, i, c), inp, carry
+            )
+            y = local_stack(carry, local_layers)
+            # stage S-1 finishes microbatch m = t-(S-1) at tick t; the
+            # forward rotation has brought m's home slot (home stage
+            # (-m) mod S, local index m // S) under stage S-1 right now
+            oidx = t - (S - 1)
+            valid = jnp.logical_and(
+                s == S - 1, jnp.logical_and(oidx >= 0, oidx < M)
+            )
+            outq = tmap(
+                lambda q, yy: jnp.where(
+                    valid,
+                    jax.lax.dynamic_update_index_in_dim(
+                        q, yy, jnp.clip(oidx // S, 0, M // S - 1), 0
+                    ),
+                    q,
+                ),
+                outq,
+                y,
+            )
+            carry = jax.lax.ppermute(y, AXIS_PIPE, fwd)
+            inq = tmap(lambda q: jax.lax.ppermute(q, AXIS_PIPE, ring_back), inq)
+            outq = tmap(lambda q: jax.lax.ppermute(q, AXIS_PIPE, ring_fwd), outq)
+            return (carry, inq, outq), None
+
+        carry0 = tmap(lambda q: jnp.zeros_like(q[0]), inq)
+        outq0 = tmap(lambda q: jnp.zeros_like(q), inq)
+        (_, _, outq), _ = jax.lax.scan(
+            tick, (carry0, inq, outq0), jnp.arange(T)
+        )
+        # canonicalize: T rotations have happened; finish the ring so every
+        # slot is back at its home stage (static count < S)
+        for _ in range((S - T % S) % S):
+            outq = tmap(lambda q: jax.lax.ppermute(q, AXIS_PIPE, ring_fwd), outq)
+        return outq
+
+    def stage_program_replicated(local_layers, mbs):
+        # fallback for M % S != 0: buffers replicated across stages
         s = jax.lax.axis_index(AXIS_PIPE)
         fwd = [(i, i + 1) for i in range(S - 1)]
-
-        def local_stack(c):
-            def body(c, layer):
-                return block_fn(c, layer), None
-
-            out, _ = jax.lax.scan(body, from_io(c), local_layers)
-            return tmap(to_io, out)
 
         def tick(carry_out, t):
             carry, out = carry_out
@@ -130,8 +213,7 @@ def pipeline_blocks(layer_params, x, block_fn, n_microbatches=0):
             carry = tmap(
                 lambda i, c: jnp.where(s == 0, _pvary(i), c), inp, carry
             )
-            y = local_stack(carry)
-            # stage S-1 finishes microbatch (t - (S-1)) at tick t
+            y = local_stack(carry, local_layers)
             oidx = t - (S - 1)
             valid = jnp.logical_and(
                 s == S - 1, jnp.logical_and(oidx >= 0, oidx < M)
@@ -152,7 +234,7 @@ def pipeline_blocks(layer_params, x, block_fn, n_microbatches=0):
 
         carry0 = tmap(lambda m: _pvary(jnp.zeros_like(m[0])), mbs)
         out0 = tmap(lambda m: _pvary(jnp.zeros_like(m)), mbs)
-        (_, out), _ = jax.lax.scan(tick, (carry0, out0), jnp.arange(M + S - 1))
+        (_, out), _ = jax.lax.scan(tick, (carry0, out0), jnp.arange(T))
         # results live on the last stage only; replicate them back over the
         # pipeline axis (masked psum — everyone else contributes zeros)
         return jax.lax.psum(
@@ -160,11 +242,32 @@ def pipeline_blocks(layer_params, x, block_fn, n_microbatches=0):
         )
 
     mbs = tmap(lambda l: to_io(l.reshape(M, b // M, *l.shape[1:])), x)
-    out = jax.shard_map(
-        stage_program,
-        mesh=mesh,
-        in_specs=(P(AXIS_PIPE), P()),
-        out_specs=P(),
-        axis_names={AXIS_PIPE},
-    )(layer_params, mbs)
+    if sharded_queues:
+        # queue layout: element [s, j] = microbatch j*S + s, stage dim
+        # sharded over the pipeline axis
+        inq = tmap(
+            lambda l: jnp.swapaxes(
+                l.reshape(M // S, S, *l.shape[1:]), 0, 1
+            ).reshape(M, *l.shape[1:]),
+            mbs,
+        )
+        outq = jax.shard_map(
+            stage_program_queued,
+            mesh=mesh,
+            in_specs=(P(AXIS_PIPE), P(AXIS_PIPE)),
+            out_specs=P(AXIS_PIPE),
+            axis_names={AXIS_PIPE},
+        )(layer_params, inq)
+        # outq global row s*(M/S)+j holds microbatch j*S + ((S-s) % S)
+        m_idx = np.arange(M)
+        inv = ((-m_idx) % S) * (M // S) + m_idx // S
+        out = tmap(lambda l: l[jnp.asarray(inv)], outq)
+    else:
+        out = jax.shard_map(
+            stage_program_replicated,
+            mesh=mesh,
+            in_specs=(P(AXIS_PIPE), P()),
+            out_specs=P(),
+            axis_names={AXIS_PIPE},
+        )(layer_params, mbs)
     return from_io(tmap(lambda l: l.reshape(b, *l.shape[2:]), out))

@@ -59,10 +59,24 @@ def test_pipelined_step_matches_single_device(single_device_run, mesh_cfg, devic
 
 
 def test_more_microbatches_than_stages(single_device_run, devices8):
-    """M > S shrinks the bubble; must stay numerically transparent."""
+    """M > S shrinks the bubble; must stay numerically transparent.
+    M=4, S=2 divides evenly → exercises the stage-sharded rotating queues."""
     cfg = dataclasses.replace(MODEL_CFG, pp_microbatches=4)
     ref_state, ref_losses = single_device_run
     _, losses = run_steps(MeshConfig(data=4, pipeline=2), model_cfg=cfg)
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-4, atol=2e-4)
+
+
+def test_microbatches_not_divisible_by_stages(devices8):
+    """M=3, S=2 (batch 12) → the replicated-buffer fallback path; must be
+    just as numerically transparent as the stage-sharded queue path."""
+    cfg = dataclasses.replace(MODEL_CFG, pp_microbatches=3)
+    train_cfg = dataclasses.replace(TRAIN_CFG, batch_size=12)
+    ref_state, ref_losses = run_train_steps(None, MODEL_CFG, train_cfg,
+                                            data_seed=9)
+    _, losses = run_train_steps(
+        MeshConfig(data=4, pipeline=2), cfg, train_cfg, data_seed=9
+    )
     np.testing.assert_allclose(losses, ref_losses, rtol=2e-4, atol=2e-4)
 
 
