@@ -64,6 +64,10 @@ def main():
     ap.add_argument("--loss-chunk-size", type=int, default=512)
     ap.add_argument("--no-remat", action="store_true",
                     help="disable block rematerialization (more HBM, fewer FLOPs)")
+    ap.add_argument("--remat-policy", default="full",
+                    choices=["full", "save-attn"],
+                    help="remat policy: full recompute, or keep attention "
+                         "outputs (skips recomputing the attention sublayer)")
     ap.add_argument("--flash-block-q", type=int, default=1024)
     ap.add_argument("--flash-block-kv", type=int, default=1024)
     args = ap.parse_args()
@@ -94,7 +98,7 @@ def main():
                       remat=not args.no_remat)
     model_cfg = dataclasses.replace(
         model_cfg, flash_block_q=args.flash_block_q,
-        flash_block_kv=args.flash_block_kv,
+        flash_block_kv=args.flash_block_kv, remat_policy=args.remat_policy,
     )
     train_cfg = TrainConfig(
         sequence_length=args.seq_len,

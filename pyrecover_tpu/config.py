@@ -143,6 +143,11 @@ def build_parser():
                    help="load-balance aux loss scale")
     p.add_argument("--remat", action="store_true",
                    help="Rematerialize transformer blocks (trade FLOPs for HBM).")
+    p.add_argument("--remat-policy", type=str, default="full",
+                   choices=["full", "save-attn"],
+                   help="With --remat: recompute everything, or keep each "
+                        "block's attention output (skips recomputing the "
+                        "attention sublayer in backward).")
     p.add_argument("--loss-chunk-size", type=int, default=0,
                    help=">0: compute the CE loss in sequence chunks of this size, "
                         "fusing the vocab projection (HBM saver for big vocabs).")
@@ -210,6 +215,7 @@ def get_args(argv=None):
         moe_top_k=ns.moe_top_k,
         moe_capacity_factor=ns.moe_capacity_factor,
         moe_aux_weight=ns.moe_aux_weight,
+        remat_policy=ns.remat_policy,
     )
     return TrainConfig(
         dataset=ns.dataset,

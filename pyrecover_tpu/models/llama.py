@@ -25,6 +25,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from pyrecover_tpu.ops.attention import sdpa_attention
 from pyrecover_tpu.ops.rope import apply_rope, precompute_rope
@@ -56,6 +57,11 @@ class ModelConfig:
     attention_impl: str = "sdpa"  # "sdpa" | "flash" | "ring"
     pp_microbatches: int = 0  # pipeline microbatch count; 0 → stage count
     remat: bool = False
+    # remat policy when remat=True: "full" recomputes everything
+    # (nothing_saveable); "save-attn" keeps each block's attention output
+    # (one (B,S,D) tensor per layer) so the backward skips recomputing the
+    # whole attention sublayer — a little HBM for a chunk of the remat tax
+    remat_policy: str = "full"
     # tuned on v5e at 1B/seq-2048: 1024x1024 beats 512x512 by ~6% MFU
     flash_block_q: int = 1024
     flash_block_kv: int = 1024
@@ -72,6 +78,11 @@ class ModelConfig:
             raise ValueError(
                 f"moe_top_k={self.moe_top_k} must be <= "
                 f"n_experts (--moe-experts) = {self.n_experts}"
+            )
+        if self.remat_policy not in ("full", "save-attn"):
+            raise ValueError(
+                f"remat_policy={self.remat_policy!r}: expected 'full' or "
+                "'save-attn'"
             )
 
     @property
@@ -211,6 +222,7 @@ def _block(x, layer, cos, sin, config, attn_fn):
     k = constrain(k, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, AXIS_TENSOR, None)
     v = constrain(v, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, AXIS_TENSOR, None)
     attn = attn_fn(q, k, v, causal=True)
+    attn = checkpoint_name(attn, "attn_out")
     attn = attn.reshape(b, s, cfg.n_heads * hd)
     x = x + attn @ layer["wo"].astype(cdt)
     x = constrain(x, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, None)
@@ -272,9 +284,12 @@ def forward_hidden_with_aux(params, tokens, config):
         return {"x": new_x, "aux": carry["aux"] + aux}
 
     if cfg.remat:
-        block_carry = jax.checkpoint(
-            block_carry, policy=jax.checkpoint_policies.nothing_saveable
+        policy = (
+            jax.checkpoint_policies.save_only_these_names("attn_out")
+            if cfg.remat_policy == "save-attn"
+            else jax.checkpoint_policies.nothing_saveable
         )
+        block_carry = jax.checkpoint(block_carry, policy=policy)
 
     # Under a mesh with a pipeline axis >1 this runs the microbatched
     # ppermute schedule (stages hold layer slices); otherwise it reduces to

@@ -160,3 +160,48 @@ def test_ffn_hidden_dim_formula():
     """Reference model.py:258-262 with the 8B defaults resolves to 14336."""
     cfg = ModelConfig(dim=4096, ffn_dim_multiplier=1.3, multiple_of=1024)
     assert cfg.ffn_hidden_dim == 14336
+
+
+def test_remat_policies_match_no_remat():
+    """remat=True with both policies ("full" recompute, "save-attn") must
+    produce the same loss AND gradients as remat=False — rematerialization
+    is a memory strategy, never a numerics change."""
+    import dataclasses
+
+    from pyrecover_tpu.models.llama import forward_hidden_with_aux
+
+    base = ModelConfig().tiny(max_seq_len=32, vocab_size=128, n_layers=2)
+    params = init_params(jax.random.key(0), base)
+    tokens = jnp.asarray(
+        np.random.default_rng(2).integers(0, 128, (2, 32)), dtype=jnp.int32
+    )
+
+    def loss(p, cfg):
+        h, aux = forward_hidden_with_aux(p, tokens, cfg)
+        return jnp.sum(h.astype(jnp.float32) ** 2) + jnp.sum(aux)
+
+    ref_cfg = dataclasses.replace(base, remat=False)
+    ref_val, ref_grads = jax.jit(
+        jax.value_and_grad(lambda p: loss(p, ref_cfg))
+    )(params)
+
+    for policy in ("full", "save-attn"):
+        cfg = dataclasses.replace(base, remat=True, remat_policy=policy)
+        val, grads = jax.jit(
+            jax.value_and_grad(lambda p: loss(p, cfg))
+        )(params)
+        np.testing.assert_allclose(np.asarray(val), np.asarray(ref_val),
+                                   rtol=1e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(ref_grads),
+                        jax.tree_util.tree_leaves(grads)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_invalid_remat_policy_rejected():
+    import dataclasses
+
+    import pytest
+
+    with pytest.raises(ValueError, match="remat_policy"):
+        dataclasses.replace(ModelConfig().tiny(), remat_policy="attn")
