@@ -35,6 +35,9 @@ class TrainConfig:
     # -- optimization --------------------------------------------------------
     learning_rate: float = 1e-5
     lr_warmup_steps: int = 10
+    lr_schedule: str = "constant"  # "constant" (reference) | "cosine"
+    lr_min_ratio: float = 0.1  # cosine floor as a fraction of peak LR
+    grad_accumulation_steps: int = 1  # micro-steps per optimizer update
     weight_decay: float = 0.1
     adam_b1: float = 0.9
     adam_b2: float = 0.95
@@ -70,6 +73,10 @@ class TrainConfig:
     # deadline/notice checks (device sync + cross-host broadcast) run every
     # k-th step; the safety buffer absorbs the ≤(k-1)-step decision delay
     preempt_check_interval: int = 5
+    # -- evaluation (beyond-parity: the reference has no eval loop) ----------
+    eval_frequency: int = 0  # every k steps; 0 disables
+    eval_samples: int = 64  # held-out sample count per evaluation
+    eval_dataset: str = ""  # parquet path; "" → held-out synthetic split
     # -- observability -------------------------------------------------------
     logging_frequency: int = 5
     log_loss_to_csv: bool = False
@@ -111,6 +118,16 @@ def build_parser():
     # optimization (utils.py:133-151, 171-175)
     p.add_argument("--learning-rate", type=float, default=d.learning_rate)
     p.add_argument("--lr-warmup-steps", type=int, default=d.lr_warmup_steps)
+    p.add_argument("--lr-schedule", type=str, default=d.lr_schedule,
+                   choices=["constant", "cosine"],
+                   help="constant after warmup (reference) or cosine decay "
+                        "to --lr-min-ratio over --training-steps.")
+    p.add_argument("--lr-min-ratio", type=float, default=d.lr_min_ratio)
+    p.add_argument("--grad-accumulation-steps", type=int,
+                   default=d.grad_accumulation_steps,
+                   help="Split each global batch into this many micro-steps "
+                        "(scanned inside the jitted step); gradients "
+                        "accumulate in f32 before one optimizer update.")
     p.add_argument("--weight-decay", type=float, default=d.weight_decay)
     p.add_argument("--grad-max-norm", type=float, default=d.grad_max_norm)
     p.add_argument("--no-grad-clipping", action="store_true",
@@ -192,6 +209,14 @@ def build_parser():
                    help="Run the deadline/notice check (device sync + cross-"
                         "host broadcast) every k-th step instead of every step.")
 
+    # evaluation (beyond-parity)
+    p.add_argument("--eval-frequency", type=int, default=d.eval_frequency,
+                   help="Evaluate on a held-out split every k steps (0 = off).")
+    p.add_argument("--eval-samples", type=int, default=d.eval_samples)
+    p.add_argument("--eval-dataset", type=str, default=d.eval_dataset,
+                   help="Parquet file for eval; default holds out a "
+                        "synthetic split (different seed from training).")
+
     # observability (utils.py:152-170, 249-254)
     p.add_argument("--logging-frequency", type=int, default=d.logging_frequency)
     p.add_argument("--log-loss-to-csv", action="store_true")
@@ -225,6 +250,9 @@ def get_args(argv=None):
         training_samples=ns.training_samples,
         learning_rate=ns.learning_rate,
         lr_warmup_steps=ns.lr_warmup_steps,
+        lr_schedule=ns.lr_schedule,
+        lr_min_ratio=ns.lr_min_ratio,
+        grad_accumulation_steps=ns.grad_accumulation_steps,
         weight_decay=ns.weight_decay,
         grad_max_norm=ns.grad_max_norm,
         grad_clipping=not ns.no_grad_clipping,
@@ -253,6 +281,9 @@ def get_args(argv=None):
         default_ckpt_time=ns.default_ckpt_time,
         job_end_time=ns.job_end_time,
         preempt_check_interval=ns.preempt_check_interval,
+        eval_frequency=ns.eval_frequency,
+        eval_samples=ns.eval_samples,
+        eval_dataset=ns.eval_dataset,
         logging_frequency=ns.logging_frequency,
         log_loss_to_csv=ns.log_loss_to_csv,
         profile=ns.profile,

@@ -33,12 +33,33 @@ def warmup_constant_schedule(base_lr, warmup_steps):
     )
 
 
+def warmup_cosine_schedule(base_lr, warmup_steps, total_steps, min_ratio=0.1):
+    """Linear warmup → cosine decay to ``min_ratio``·base_lr at
+    ``total_steps``. (Beyond-parity: the reference only has
+    warmup→constant; cosine is the standard pre-training schedule.)"""
+    return optax.schedules.warmup_cosine_decay_schedule(
+        init_value=base_lr / max(warmup_steps, 1),
+        peak_value=base_lr,
+        warmup_steps=max(warmup_steps, 1),
+        decay_steps=max(total_steps, warmup_steps + 1),
+        end_value=base_lr * min_ratio,
+    )
+
+
 def build_optimizer(config):
-    """AdamW + warmup-constant LR (+ optional global-norm clipping).
+    """AdamW + warmup LR schedule (+ optional global-norm clipping).
 
     ``config`` is a TrainConfig (pyrecover_tpu.config).
     """
-    schedule = warmup_constant_schedule(config.learning_rate, config.lr_warmup_steps)
+    if getattr(config, "lr_schedule", "constant") == "cosine":
+        schedule = warmup_cosine_schedule(
+            config.learning_rate, config.lr_warmup_steps,
+            config.training_steps, config.lr_min_ratio,
+        )
+    else:
+        schedule = warmup_constant_schedule(
+            config.learning_rate, config.lr_warmup_steps
+        )
     components = []
     if config.grad_clipping and config.grad_max_norm > 0:
         components.append(optax.clip_by_global_norm(config.grad_max_norm))

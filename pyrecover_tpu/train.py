@@ -33,7 +33,12 @@ from pyrecover_tpu.optim import build_optimizer
 from pyrecover_tpu.parallel.mesh import create_mesh, initialize_distributed
 from pyrecover_tpu.parallel.sharding import param_pspecs, _leaf_rule
 from pyrecover_tpu.preempt import PreemptionWatcher, write_requeue_marker
-from pyrecover_tpu.train_state import TrainState, create_train_state, make_train_step
+from pyrecover_tpu.train_state import (
+    TrainState,
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+)
 from pyrecover_tpu.utils.logging import init_logger, log_host0
 from pyrecover_tpu.utils.perf import get_num_params
 
@@ -95,6 +100,61 @@ def build_dataset(config):
         seed=config.seed,
     )
     return ds, 0, config.model
+
+
+def build_eval_runner(config, model_config, pad_token_id, mesh):
+    """Held-out evaluation: returns ``run_eval(state) -> mean_loss`` or None.
+
+    Beyond-parity — the reference has no eval loop. ``--eval-dataset``
+    names a parquet file; without it a synthetic split on a DIFFERENT seed
+    from training serves as the held-out data. Losses are averaged exactly
+    (Σ CE-sums / Σ valid tokens) across ``--eval-samples`` samples.
+    """
+    if config.eval_frequency <= 0:
+        return None
+    if config.eval_dataset:
+        from pyrecover_tpu.data.parquet import ParquetTextDataset, load_tokenizer
+
+        tokenizer = load_tokenizer(config.tokenizer_name_or_path)
+        eval_ds = ParquetTextDataset(
+            config.eval_dataset, tokenizer, config.sequence_length,
+            training_samples=config.eval_samples,
+        )
+        # the eval tokenizer's own pad id, not the training dataset's —
+        # wrong masking would score pad positions as real tokens
+        pad_token_id = eval_ds.pad_token_id
+    else:
+        # Same distribution, different draw. The synthetic task's sequence
+        # universe is closed (affine recurrence keyed by start token), so
+        # this measures fit on the distribution, not generalization to
+        # unseen text — use --eval-dataset for a genuinely held-out corpus.
+        eval_ds = SyntheticTextDataset(
+            num_samples=config.eval_samples,
+            seq_len=config.sequence_length,
+            vocab_size=model_config.vocab_size,
+            seed=config.seed + 1,
+        )
+    batch = min(config.batch_size, len(eval_ds))
+    n_batches = max(len(eval_ds) // batch, 1)
+    eval_step = make_eval_step(model_config, config.loss_chunk_size)
+
+    def run_eval(state):
+        sampler = StatefulSampler(
+            dataset_len=len(eval_ds), global_batch_size=batch,
+            seed=config.seed + 1, shuffle=False,
+        )
+        loader = DataLoader(
+            eval_ds, sampler, pad_token_id=pad_token_id, mesh=mesh, prefetch=0
+        )
+        ce_sum, n_tok = 0.0, 0
+        for _ in range(n_batches):
+            _, b = next(loader)
+            s, n = eval_step(state.params, b)
+            ce_sum += float(s)
+            n_tok += int(n)
+        return ce_sum / max(n_tok, 1)
+
+    return run_eval
 
 
 def train(config: TrainConfig):
@@ -221,7 +281,10 @@ def train(config: TrainConfig):
         prefetch=2, num_workers=4,
     ).start()
 
-    step_fn = make_train_step(model_config, optimizer, loss_chunk_size=config.loss_chunk_size)
+    step_fn = make_train_step(
+        model_config, optimizer, loss_chunk_size=config.loss_chunk_size,
+        grad_accumulation_steps=config.grad_accumulation_steps,
+    )
     # MFU/TFLOPs use the reference's 6N convention: token embedding excluded
     # (ref train.py:126-127), untied output projection kept.
     meter = ThroughputMeter(
@@ -233,6 +296,7 @@ def train(config: TrainConfig):
     csv_logger = LossCSVLogger(exp_dir, config.experiment_name,
                                enabled=config.log_loss_to_csv,
                                resume_step=start_step)
+    run_eval = build_eval_runner(config, model_config, pad_token_id, mesh)
     watcher = PreemptionWatcher(
         enabled=config.timeaware_checkpointing,
         default_iter_time=config.default_iter_time,
@@ -289,6 +353,16 @@ def train(config: TrainConfig):
             if config.profile and step == config.profile_step_end and profiling:
                 jax.profiler.stop_trace()
                 profiling = False
+
+            # held-out evaluation (beyond-parity)
+            if run_eval is not None and step % config.eval_frequency == 0:
+                eval_loss = run_eval(state)
+                log_host0("eval | step %d | loss %.4f", step, eval_loss)
+                # exclude eval wall time from iter-time learning AND the
+                # throughput window (else tok/s and MFU logs are understated)
+                sync_t0 = time.monotonic()
+                steps_since_sync = 0
+                meter.reset()
 
             # periodic checkpoint (reference train.py:310-331)
             if (

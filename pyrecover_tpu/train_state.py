@@ -110,34 +110,103 @@ def chunked_loss(params, tokens, labels, model_config, chunk_size):
     return chunked_ce(params, hidden, labels, model_config, chunk_size)
 
 
-def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0):
+def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0,
+                    grad_accumulation_steps=1):
     """Build the jitted functional train step.
 
     state, batch → new_state, metrics. Under a mesh, batch/params shardings
     propagate through (GSPMD); the DP gradient AllReduce the reference gets
     from DDP (`train.py:268-269`) is inserted by XLA automatically.
     ``loss_chunk_size`` > 0 enables the chunked fused loss (see
-    ``chunked_loss``).
+    ``chunked_loss``). ``grad_accumulation_steps`` > 1 splits the global
+    batch into that many micro-batches scanned inside the SAME jitted step
+    — one live micro-batch of activations at a time, one optimizer update —
+    with EXACT full-batch normalization: the valid-token total is counted
+    from the labels up front (data-only, no model), so each micro-step's
+    objective is ``Σ_chunk CE / N_total`` and the accumulated f32 gradient
+    equals the unaccumulated one.
     """
+    A = int(grad_accumulation_steps)
+    if A < 1:
+        raise ValueError(
+            f"grad_accumulation_steps must be >= 1, got {grad_accumulation_steps}"
+        )
+
+    def micro_loss(params, inputs, labels, n_total, rows_total):
+        """Micro-batch objective: ``Σ_chunk CE / N_total`` (+ row-weighted
+        aux). Its grads SUM over micro-steps to the full-batch grads."""
+        from pyrecover_tpu.models.llama import forward_hidden_with_aux
+
+        hidden, moe_aux = forward_hidden_with_aux(params, inputs, model_config)
+        ce, n = chunked_ce(params, hidden, labels, model_config, loss_chunk_size)
+        total = ce * jnp.maximum(n, 1).astype(jnp.float32) / n_total
+        if model_config.n_experts > 0:
+            # moe_aux is this micro-batch's per-row mean; reweight so the
+            # sum over micro-steps is the full-batch row mean
+            total = total + model_config.moe_aux_weight * moe_aux * (
+                inputs.shape[0] / rows_total
+            )
+        return total, moe_aux
 
     def step_fn(state, batch):
-        def loss_fn(params):
-            from pyrecover_tpu.models.llama import forward_hidden_with_aux
+        if A == 1:
+            def loss_fn(params):
+                from pyrecover_tpu.models.llama import forward_hidden_with_aux
 
-            hidden, moe_aux = forward_hidden_with_aux(
-                params, batch["inputs"], model_config
+                hidden, moe_aux = forward_hidden_with_aux(
+                    params, batch["inputs"], model_config
+                )
+                ce, n_valid = chunked_ce(
+                    params, hidden, batch["labels"], model_config,
+                    loss_chunk_size,
+                )
+                total = ce
+                if model_config.n_experts > 0:
+                    total = ce + model_config.moe_aux_weight * moe_aux
+                return total, (ce, n_valid, moe_aux)
+
+            (_, (loss, n_valid, moe_aux)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params)
+        else:
+            B = batch["inputs"].shape[0]
+            if B % A:
+                raise ValueError(
+                    f"batch {B} not divisible by grad_accumulation_steps {A}"
+                )
+            inputs = batch["inputs"].reshape(A, B // A, -1)
+            labels = batch["labels"].reshape(A, B // A, -1)
+            n_total = jnp.maximum(
+                jnp.sum(labels != IGNORE_INDEX), 1
+            ).astype(jnp.float32)
+
+            def micro(acc, xs):
+                inp, lab = xs
+                (obj, moe_aux), g = jax.value_and_grad(
+                    micro_loss, has_aux=True
+                )(state.params, inp, lab, n_total, float(B))
+                acc_g, acc_obj, acc_aux = acc
+                acc_g = jax.tree_util.tree_map(
+                    lambda a, b: a + b.astype(jnp.float32), acc_g, g
+                )
+                return (acc_g, acc_obj + obj,
+                        acc_aux + moe_aux * (inp.shape[0] / B)), None
+
+            zero_g = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params
             )
-            ce, n_valid = chunked_ce(
-                params, hidden, batch["labels"], model_config, loss_chunk_size
+            (grads, obj, moe_aux), _ = jax.lax.scan(
+                micro, (zero_g, jnp.float32(0), jnp.float32(0)),
+                (inputs, labels),
             )
-            total = ce
+            grads = jax.tree_util.tree_map(
+                lambda g, p: g.astype(p.dtype), grads, state.params
+            )
+            n_valid = n_total.astype(jnp.int32)
+            loss = obj
             if model_config.n_experts > 0:
-                total = ce + model_config.moe_aux_weight * moe_aux
-            return total, (ce, n_valid, moe_aux)
+                loss = obj - model_config.moe_aux_weight * moe_aux
 
-        (_, (loss, n_valid, moe_aux)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(state.params)
         updates, new_opt_state = optimizer.update(
             grads, state.opt_state, state.params
         )
@@ -172,5 +241,25 @@ def eval_loss_fn(model_config):
     def fn(params, batch):
         logits = forward(params, batch["inputs"], model_config)
         return masked_cross_entropy(logits, batch["labels"])[0]
+
+    return fn
+
+
+def make_eval_step(model_config, loss_chunk_size=0):
+    """Jitted evaluation step: (params, batch) → (ce_sum, n_valid).
+
+    Returns the UN-normalized CE sum plus the valid-token count so the
+    caller can average exactly over many eval batches. Uses the chunked
+    fused loss (never materializes full logits) like the train step.
+    """
+    from pyrecover_tpu.models.llama import forward_hidden
+
+    @partial(jax.jit)
+    def fn(params, batch):
+        hidden = forward_hidden(params, batch["inputs"], model_config)
+        ce, n_valid = chunked_ce(
+            params, hidden, batch["labels"], model_config, loss_chunk_size
+        )
+        return ce * jnp.maximum(n_valid, 1).astype(jnp.float32), n_valid
 
     return fn

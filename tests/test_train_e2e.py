@@ -129,3 +129,26 @@ def test_done_marker_on_completion(tmp_path):
     assert (exp / DONE_MARKER).exists()
     # checkpoint_frequency=-1 disables saves entirely (reference utils.py:205)
     assert not list(exp.glob("ckpt_*"))
+
+
+def test_eval_loop_and_grad_accum_through_driver(tmp_path, caplog):
+    """--eval-frequency produces held-out eval losses; grad accumulation
+    runs through the driver; both compose with checkpointing."""
+    import logging
+
+    cfg = tiny_config(
+        tmp_path, training_steps=4, eval_frequency=2, eval_samples=16,
+        grad_accumulation_steps=2,
+    )
+    from pyrecover_tpu.utils.logging import init_logger
+
+    logger = init_logger()  # configure now so train() won't reset propagate
+    logger.propagate = True  # let caplog see host-0 records
+    try:
+        with caplog.at_level(logging.INFO, logger="pyrecover_tpu"):
+            state, end_step, stopped = train(cfg)
+    finally:
+        logger.propagate = False
+    assert end_step == 4 and not stopped
+    evals = [r for r in caplog.records if "eval | step" in r.getMessage()]
+    assert len(evals) == 2  # steps 2 and 4

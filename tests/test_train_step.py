@@ -142,3 +142,104 @@ def test_bitexact_resume_vanilla(tmp_ckpt_dir):
         jax.tree_util.tree_leaves(straight), jax.tree_util.tree_leaves(state)
     ):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_grad_accumulation_matches_full_batch():
+    """accum=A over the same global batch must produce the same loss AND
+    the same updated parameters as one unaccumulated step — the exact
+    Σ CE / N_total normalization, not a per-chunk average."""
+    import dataclasses
+
+    from pyrecover_tpu.data import DataLoader, StatefulSampler, SyntheticTextDataset
+
+    cfg = MODEL_CFG
+    train_cfg = TrainConfig(
+        sequence_length=32, batch_size=8, learning_rate=1e-3,
+        model_dtype="fp32", param_dtype="fp32",
+    )
+    train_cfg.model = cfg
+    train_cfg.__post_init__()
+    optimizer, _ = build_optimizer(train_cfg)
+
+    def run(accum):
+        ds = SyntheticTextDataset(num_samples=32, seq_len=32,
+                                  vocab_size=cfg.vocab_size, seed=21)
+        sampler = StatefulSampler(dataset_len=32, global_batch_size=8, seed=21)
+        loader = DataLoader(ds, sampler, pad_token_id=0, prefetch=0)
+        state = create_train_state(jax.random.key(0), train_cfg.model, optimizer)
+        step = make_train_step(train_cfg.model, optimizer, donate=False,
+                               grad_accumulation_steps=accum)
+        losses = []
+        for _ in range(3):
+            _, batch = next(loader)
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+        return state, losses
+
+    ref_state, ref_losses = run(1)
+    acc_state, acc_losses = run(4)
+    np.testing.assert_allclose(acc_losses, ref_losses, rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(ref_state.params),
+                    jax.tree_util.tree_leaves(acc_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_grad_accumulation_moe_matches():
+    """Accumulation must also be exact for MoE (row-weighted aux loss)."""
+    import dataclasses
+
+    from pyrecover_tpu.data import DataLoader, StatefulSampler, SyntheticTextDataset
+
+    cfg = MODEL_CFG
+    moe_cfg = dataclasses.replace(cfg, n_experts=4, moe_top_k=2)
+    train_cfg = TrainConfig(
+        sequence_length=32, batch_size=8, learning_rate=1e-3,
+        model_dtype="fp32", param_dtype="fp32",
+    )
+    train_cfg.model = moe_cfg
+    train_cfg.__post_init__()
+    optimizer, _ = build_optimizer(train_cfg)
+
+    def run(accum):
+        ds = SyntheticTextDataset(num_samples=32, seq_len=32,
+                                  vocab_size=moe_cfg.vocab_size, seed=22)
+        sampler = StatefulSampler(dataset_len=32, global_batch_size=8, seed=22)
+        loader = DataLoader(ds, sampler, pad_token_id=0, prefetch=0)
+        state = create_train_state(jax.random.key(0), train_cfg.model, optimizer)
+        step = make_train_step(train_cfg.model, optimizer, donate=False,
+                               grad_accumulation_steps=accum)
+        for _ in range(2):
+            _, batch = next(loader)
+            state, m = step(state, batch)
+        return state, float(m["loss"]), float(m["moe_aux"])
+
+    ref_state, ref_loss, ref_aux = run(1)
+    acc_state, acc_loss, acc_aux = run(2)
+    np.testing.assert_allclose(acc_loss, ref_loss, rtol=1e-5)
+    np.testing.assert_allclose(acc_aux, ref_aux, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(ref_state.params),
+                    jax.tree_util.tree_leaves(acc_state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_cosine_schedule_shape():
+    """Warmup to peak, decays to lr_min_ratio·peak by training_steps."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        TRAIN_CFG, lr_schedule="cosine", lr_min_ratio=0.1,
+        training_steps=100, lr_warmup_steps=10, learning_rate=1e-2,
+    )
+    _, sched = build_optimizer(cfg)
+    assert float(sched(0)) < float(sched(9))
+    np.testing.assert_allclose(float(sched(10)), 1e-2, rtol=1e-6)
+    assert float(sched(50)) < 1e-2
+    np.testing.assert_allclose(float(sched(100)), 1e-3, rtol=1e-2)
+
+
+def test_constant_schedule_is_reference_default():
+    _, sched = build_optimizer(TRAIN_CFG)
+    np.testing.assert_allclose(float(sched(1000)), TRAIN_CFG.learning_rate,
+                               rtol=1e-6)
