@@ -62,6 +62,10 @@ def main():
                          "single-chip tunnel)")
     ap.add_argument("--learning-rate", type=float, default=3e-4)
     ap.add_argument("--loss-chunk-size", type=int, default=512)
+    ap.add_argument("--grad-accumulation-steps", "--grad-accum",
+                    dest="grad_accum", type=int, default=1,
+                    help="micro-steps per optimizer update (scanned inside "
+                         "the jitted step); batch-size is the GLOBAL batch")
     ap.add_argument("--no-remat", action="store_true",
                     help="disable block rematerialization (more HBM, fewer FLOPs)")
     ap.add_argument("--remat-policy", default="full",
@@ -125,7 +129,10 @@ def main():
     )
     sampler = StatefulSampler(dataset_len=1024, global_batch_size=args.batch_size)
     loader = DataLoader(ds, sampler, pad_token_id=0, mesh=mesh, prefetch=2).start()
-    step_fn = make_train_step(model_cfg, optimizer, loss_chunk_size=args.loss_chunk_size)
+    step_fn = make_train_step(
+        model_cfg, optimizer, loss_chunk_size=args.loss_chunk_size,
+        grad_accumulation_steps=args.grad_accum,
+    )
 
     def sync(state):
         # Materialize a value derived from the updated params. On the

@@ -5,17 +5,43 @@ parquet of a ``text`` column, virtual length with index wraparound, per-item
 tokenization to seq_len+1 with right-padding and truncation. The hot-loop
 tokenization cost the reference pays per step (SURVEY hard-part #5) is
 hidden by the DataLoader's background prefetch pool, not by this class.
+
+Beyond parity: the path may be a single file, a glob (``shards-*.parquet``),
+or a directory of ``*.parquet`` shards — real corpora ship sharded; shards
+are concatenated in sorted order so data order is deterministic.
 """
 
+import glob as _glob
+from pathlib import Path
+
 import numpy as np
+
+
+def _resolve_parquet_files(path):
+    """One file, a glob pattern, or a directory of *.parquet → sorted list."""
+    p = Path(path)
+    if p.is_dir():
+        files = sorted(str(f) for f in p.glob("*.parquet"))
+    elif any(ch in str(path) for ch in "*?["):
+        files = sorted(_glob.glob(str(path)))
+    else:
+        files = [str(path)]
+    if not files:
+        raise FileNotFoundError(f"no parquet files match {path!r}")
+    return files
 
 
 class ParquetTextDataset:
     def __init__(self, parquet_file, tokenizer, seq_len, training_samples=0,
                  text_column="text"):
+        import pyarrow as pa
         import pyarrow.parquet as pq
 
-        table = pq.read_table(parquet_file, memory_map=True, columns=[text_column])
+        tables = [
+            pq.read_table(f, memory_map=True, columns=[text_column])
+            for f in _resolve_parquet_files(parquet_file)
+        ]
+        table = tables[0] if len(tables) == 1 else pa.concat_tables(tables)
         self.texts = table.column(text_column)
         self.real_length = len(self.texts)
         self.num_samples = int(training_samples) if training_samples else self.real_length

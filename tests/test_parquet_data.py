@@ -98,3 +98,24 @@ def test_training_on_parquet(parquet_file, tmp_path):
         state, metrics = step_fn(state, batch)
     assert np.isfinite(float(metrics["loss"]))
     assert int(state.step) == 3
+
+
+@pytest.mark.skipif(not HAVE_TOKENIZERS, reason="tokenizers not installed")
+def test_sharded_parquet_dir_and_glob(tmp_path):
+    """A directory of shards / a glob pattern loads as one concatenated
+    dataset, shards in sorted order (deterministic data order)."""
+    d = tmp_path / "shards"
+    d.mkdir()
+    pq.write_table(pa.table({"text": TEXTS[:2]}), d / "part-00.parquet")
+    pq.write_table(pa.table({"text": TEXTS[2:]}), d / "part-01.parquet")
+
+    tok = make_tokenizer()
+    ref = ParquetTextDataset(d / "part-00.parquet", tok, seq_len=8)
+    ds_dir = ParquetTextDataset(d, tok, seq_len=8)
+    ds_glob = ParquetTextDataset(str(d / "part-*.parquet"), tok, seq_len=8)
+    assert len(ds_dir) == len(TEXTS) == len(ds_glob)
+    np.testing.assert_array_equal(ds_dir[0], ref[0])  # sorted shard order
+    np.testing.assert_array_equal(ds_dir[1], ds_glob[1])
+
+    with pytest.raises(FileNotFoundError):
+        ParquetTextDataset(str(d / "nope-*.parquet"), tok, seq_len=8)

@@ -1,0 +1,179 @@
+"""Sample text from a pyrecover_tpu checkpoint (either format).
+
+Beyond-parity utility (the reference has no generation path at all): loads
+a checkpoint's params, then decodes greedily or with temperature sampling.
+Decoding re-runs the full forward per generated token (no KV cache — this
+is a verification/demo tool, not a serving engine; the training forward is
+deliberately cache-free).
+
+Usage:
+  python tools/generate.py CKPT --model llama-150m --prompt-ids 1,2,3 \
+      --max-new-tokens 32 [--temperature 0.8] [--tokenizer NAME --prompt "text"]
+
+Exit codes: 0 = ok, 2 = error.
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def build_state(model_cfg):
+    import jax
+
+    from pyrecover_tpu.config import TrainConfig
+    from pyrecover_tpu.optim import build_optimizer
+    from pyrecover_tpu.train_state import create_train_state
+
+    tc = TrainConfig(sequence_length=model_cfg.max_seq_len)
+    tc.model = model_cfg
+    tc.__post_init__()
+    optimizer, _ = build_optimizer(tc)
+    return create_train_state(jax.random.key(0), tc.model, optimizer), tc.model
+
+
+def load_params(path, model_cfg):
+    if Path(path).is_dir():
+        # sharded (Orbax) stores the whole TrainState; restore it all
+        from pyrecover_tpu.checkpoint import load_ckpt_sharded
+
+        target, model_cfg = build_state(model_cfg)
+        state, _, _ = load_ckpt_sharded(path, target)
+        return state.params, model_cfg
+    # vanilla: select only the params leaves (".params[...]" key paths) —
+    # no need to read Adam moments into memory for a params-only tool
+    import jax
+    import jax.numpy as jnp
+
+    from pyrecover_tpu.checkpoint.vanilla import read_ckpt_raw
+    from pyrecover_tpu.models.llama import init_params
+
+    _, paths, leaves = read_ckpt_raw(path)
+    abstract = jax.eval_shape(lambda: init_params(jax.random.key(0), model_cfg))
+    p_leaves, treedef = jax.tree_util.tree_flatten(abstract)
+    picked = [
+        leaf for kp, leaf in zip(paths, leaves) if kp.startswith(".params")
+    ]
+    if len(picked) != len(p_leaves):
+        raise ValueError(
+            f"checkpoint has {len(picked)} params leaves, model expects "
+            f"{len(p_leaves)} — wrong --model shape?"
+        )
+    params = jax.tree_util.tree_unflatten(
+        treedef,
+        [jnp.asarray(l).astype(t.dtype) for l, t in zip(picked, p_leaves)],
+    )
+    return params, model_cfg
+
+
+def generate(params, model_cfg, prompt_ids, max_new_tokens, temperature, seed):
+    import jax
+    import jax.numpy as jnp
+
+    from pyrecover_tpu.models.llama import forward
+
+    ids = list(int(t) for t in prompt_ids)
+    rng = jax.random.key(seed)
+    # fixed-shape window (right-padded to max_seq_len) → exactly ONE compile;
+    # causal attention makes the positions past the read index inert
+    fwd = jax.jit(lambda p, t: forward(p, t, model_cfg))
+    L = model_cfg.max_seq_len
+    for _ in range(max_new_tokens):
+        window = ids[-L:]
+        pos = len(window) - 1
+        padded = window + [0] * (L - len(window))
+        tokens = jnp.asarray([padded], dtype=jnp.int32)
+        logits = fwd(params, tokens)[0, pos]
+        if temperature > 0:
+            rng, sub = jax.random.split(rng)
+            nxt = int(jax.random.categorical(sub, logits / temperature))
+        else:
+            nxt = int(jnp.argmax(logits))
+        ids.append(nxt)
+    return ids
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("checkpoint", help="vanilla .ckpt file or sharded dir")
+    ap.add_argument("--model", default="llama-150m",
+                    help="preset name (models/presets.py)")
+    ap.add_argument("--vocab-size", type=int, default=0,
+                    help="override preset vocab (must match the checkpoint)")
+    ap.add_argument("--model-dim", type=int, default=0,
+                    help="with --model-layers/--model-heads/--model-kv-heads:"
+                         " build a custom shape instead of a preset")
+    ap.add_argument("--model-layers", type=int, default=0)
+    ap.add_argument("--model-heads", type=int, default=0)
+    ap.add_argument("--model-kv-heads", type=int, default=0)
+    ap.add_argument("--max-seq-len", type=int, default=0)
+    ap.add_argument("--multiple-of", type=int, default=0)
+    ap.add_argument("--prompt-ids", default="1",
+                    help="comma-separated token ids")
+    ap.add_argument("--prompt", default="",
+                    help="text prompt (requires --tokenizer)")
+    ap.add_argument("--tokenizer", default="",
+                    help="HF tokenizer name/path for --prompt and decoding")
+    ap.add_argument("--max-new-tokens", type=int, default=32)
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="0 = greedy")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    try:
+        import dataclasses
+
+        from pyrecover_tpu.models import presets
+        from pyrecover_tpu.models.llama import ModelConfig
+
+        shape_flags = (args.model_layers, args.model_heads, args.model_kv_heads)
+        if args.model_dim:
+            cfg = ModelConfig(
+                dim=args.model_dim, n_layers=args.model_layers,
+                n_heads=args.model_heads, n_kv_heads=args.model_kv_heads,
+                vocab_size=args.vocab_size or 32768,
+                max_seq_len=args.max_seq_len or 2048,
+                multiple_of=args.multiple_of or 1024,
+            )
+        else:
+            if any(shape_flags) or args.multiple_of:
+                print("--model-layers/-heads/-kv-heads/--multiple-of require "
+                      "--model-dim (custom shape)", file=sys.stderr)
+                return 2
+            cfg = presets.PRESETS[args.model]()
+            if args.max_seq_len:
+                # must match the sequence length the model was trained with
+                cfg = dataclasses.replace(cfg, max_seq_len=args.max_seq_len)
+        if args.vocab_size:
+            cfg = dataclasses.replace(cfg, vocab_size=args.vocab_size)
+
+        tokenizer = None
+        if args.tokenizer:
+            from pyrecover_tpu.data.parquet import load_tokenizer
+
+            tokenizer = load_tokenizer(args.tokenizer)
+        if args.prompt:
+            if tokenizer is None:
+                print("--prompt requires --tokenizer", file=sys.stderr)
+                return 2
+            prompt_ids = tokenizer(args.prompt)["input_ids"]
+        else:
+            prompt_ids = [int(x) for x in args.prompt_ids.split(",")]
+
+        params, cfg = load_params(args.checkpoint, cfg)
+        ids = generate(params, cfg, prompt_ids, args.max_new_tokens,
+                       args.temperature, args.seed)
+        if tokenizer is not None:
+            print(tokenizer.decode(ids))
+        else:
+            print(",".join(str(i) for i in ids))
+        return 0
+    except Exception as e:  # tool: fail with a message, not a traceback wall
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
