@@ -174,11 +174,16 @@ def main():
     peak = tpu_peak_flops()
     mfu = flop_per_token * tok_per_sec / (peak * n_devices)
 
+    # live HBM after the hot loop (params + opt state + cached buffers)
+    mem = getattr(jax.devices()[0], "memory_stats", lambda: None)() or {}
+    hbm_gb = round(mem.get("bytes_in_use", 0) / 1e9, 2) or None
+
     extra = {
         "model": args.model,
         "n_params": n_params,
         "platform": platform,
         "n_devices": n_devices,
+        "hbm_in_use_gb": hbm_gb,
         "seq_len": args.seq_len,
         "batch_size": args.batch_size,
         "step_time_s": round(dt / args.steps, 4),

@@ -50,6 +50,9 @@ class TrainConfig:
     model_dtype: str = "bf16"  # compute dtype (reference --model-dtype)
     param_dtype: str = "fp32"  # master weights; TPU-native improvement over all-bf16
     use_flash_attention: bool = False
+    # "auto": ring when --sp > 1 (sequence-sharded ppermute ring — the
+    # long-context path), else flash if --use_flash_attention, else sdpa
+    attention_impl: str = "auto"  # auto | sdpa | flash | ring
     remat: bool = False
     pp_microbatches: int = 0  # pipeline microbatches; 0 → stage count
     loss_chunk_size: int = 0  # >0: fused chunked CE, never materializes full logits
@@ -86,6 +89,15 @@ class TrainConfig:
     profile_dir: str = "profiles/"
 
     def __post_init__(self):
+        if self.attention_impl == "auto":
+            if self.mesh.sequence > 1:
+                attn = "ring"
+            elif self.use_flash_attention:
+                attn = "flash"
+            else:
+                attn = self.model.attention_impl
+        else:
+            attn = self.attention_impl
         self.model = dataclasses.replace(
             self.model,
             max_seq_len=self.sequence_length,
@@ -93,7 +105,7 @@ class TrainConfig:
                            "fp64": "float64"}.get(self.model_dtype, self.model_dtype),
             param_dtype={"bf16": "bfloat16", "fp16": "float16", "fp32": "float32",
                          "fp64": "float64"}.get(self.param_dtype, self.param_dtype),
-            attention_impl="flash" if self.use_flash_attention else self.model.attention_impl,
+            attention_impl=attn,
             remat=self.remat or self.model.remat,
             pp_microbatches=self.pp_microbatches or self.model.pp_microbatches,
         )
@@ -150,6 +162,11 @@ def build_parser():
                    help="Used with synthetic data; with a tokenizer, its vocab size wins.")
     p.add_argument("--use_flash_attention", "--use-flash-attention",
                    dest="use_flash_attention", action="store_true")
+    p.add_argument("--attention-impl", type=str, default=d.attention_impl,
+                   choices=["auto", "sdpa", "flash", "ring"],
+                   help="auto: ring when --sp > 1 (sequence-parallel ring "
+                        "attention), else flash if --use_flash_attention, "
+                        "else sdpa.")
     p.add_argument("--moe-experts", type=int, default=d.model.n_experts,
                    help="number of MoE experts per FFN; 0 = dense (reference)")
     p.add_argument("--moe-top-k", type=int, default=d.model.moe_top_k)
@@ -262,6 +279,7 @@ def get_args(argv=None):
         model_dtype=ns.model_dtype,
         param_dtype=ns.param_dtype,
         use_flash_attention=ns.use_flash_attention,
+        attention_impl=ns.attention_impl,
         remat=ns.remat,
         loss_chunk_size=ns.loss_chunk_size,
         mesh=MeshConfig(data=ns.dp, fsdp=ns.fsdp, tensor=ns.tp, sequence=ns.sp,

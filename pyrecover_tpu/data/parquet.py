@@ -24,6 +24,9 @@ def _resolve_parquet_files(path):
         files = sorted(str(f) for f in p.glob("*.parquet"))
     elif any(ch in str(path) for ch in "*?["):
         files = sorted(_glob.glob(str(path)))
+        if not files and p.exists():
+            # a real file whose NAME contains glob metacharacters
+            files = [str(path)]
     else:
         files = [str(path)]
     if not files:

@@ -81,3 +81,17 @@ def test_defaults_mirror_reference():
 def test_checkpoint_frequency_disable():
     cfg = get_args(["--checkpoint-frequency", "-1"])
     assert cfg.checkpoint_frequency == -1
+
+
+def test_attention_impl_auto_selection():
+    """auto → ring under --sp > 1, flash under --use_flash_attention,
+    sdpa otherwise; explicit choice always wins."""
+    from pyrecover_tpu.config import get_args
+
+    assert get_args([]).model.attention_impl == "sdpa"
+    assert get_args(["--use_flash_attention"]).model.attention_impl == "flash"
+    assert get_args(["--sp", "2"]).model.attention_impl == "ring"
+    assert get_args(
+        ["--sp", "2", "--attention-impl", "flash"]
+    ).model.attention_impl == "flash"
+    assert get_args(["--attention-impl", "ring"]).model.attention_impl == "ring"
