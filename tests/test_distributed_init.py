@@ -55,3 +55,20 @@ def test_ckpt_dir_collision_guard(tmp_path):
     cfg.__post_init__()
     with pytest.raises(NotADirectoryError):
         train(cfg)
+
+
+def test_create_mesh_shapes_and_axes(devices8):
+    """Topology-aware placement must preserve logical shape/axes; every
+    device appears exactly once."""
+    import numpy as np
+
+    from pyrecover_tpu.parallel.mesh import MESH_AXES, MeshConfig, create_mesh
+
+    mesh = create_mesh(MeshConfig(data=2, fsdp=2, tensor=2))
+    assert mesh.axis_names == MESH_AXES
+    assert dict(mesh.shape) == {
+        "pipeline": 1, "data": 2, "fsdp": 2, "tensor": 2,
+        "sequence": 1, "expert": 1,
+    }
+    ids = sorted(d.id for d in np.asarray(mesh.devices).ravel())
+    assert ids == sorted(d.id for d in devices8)
