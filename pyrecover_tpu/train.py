@@ -102,6 +102,24 @@ def build_dataset(config):
     return ds, 0, config.model
 
 
+class _PadFilledView:
+    """Dataset view of ``n_real`` corpus rows, length-padded to a whole
+    number of batches with all-pad rows (zero loss contribution)."""
+
+    def __init__(self, ds, n_real, n_total, pad_token_id, seq_len):
+        self._ds = ds
+        self._n_real = int(n_real)
+        self._n_total = int(n_total)
+        self._pad_row = np.full((int(seq_len) + 1,), pad_token_id, np.int32)
+
+    def __len__(self):
+        return self._n_total
+
+    def __getitem__(self, idx):
+        idx = int(idx)
+        return self._ds[idx] if idx < self._n_real else self._pad_row
+
+
 def build_eval_runner(config, model_config, pad_token_id, mesh):
     """Held-out evaluation: returns ``run_eval(state) -> mean_loss`` or None.
 
@@ -112,30 +130,44 @@ def build_eval_runner(config, model_config, pad_token_id, mesh):
     """
     if config.eval_frequency <= 0:
         return None
+    # keep the TRAINING batch size: it is already divisible by the mesh's
+    # batch shards; the sample count is rounded up to whole batches
+    batch = config.batch_size
     if config.eval_dataset:
         from pyrecover_tpu.data.parquet import ParquetTextDataset, load_tokenizer
 
         tokenizer = load_tokenizer(config.tokenizer_name_or_path)
-        eval_ds = ParquetTextDataset(
+        corpus = ParquetTextDataset(
             config.eval_dataset, tokenizer, config.sequence_length,
-            training_samples=config.eval_samples,
+            training_samples=0,  # natural length; no wraparound
         )
         # the eval tokenizer's own pad id, not the training dataset's —
         # wrong masking would score pad positions as real tokens
-        pad_token_id = eval_ds.pad_token_id
+        pad_token_id = corpus.pad_token_id
+        # 0 = the whole corpus (the training_samples convention)
+        n_requested = min(config.eval_samples or len(corpus), len(corpus))
+        n_batches = max((n_requested + batch - 1) // batch, 1)
+        # fill the final batch with ALL-PAD rows: their labels collate to
+        # IGNORE_INDEX, contributing exactly zero to Σ CE and Σ tokens —
+        # no document is double-counted (wraparound would reweight the
+        # corpus head)
+        eval_ds = _PadFilledView(
+            corpus, n_requested, n_batches * batch, pad_token_id,
+            config.sequence_length,
+        )
     else:
         # Same distribution, different draw. The synthetic task's sequence
         # universe is closed (affine recurrence keyed by start token), so
         # this measures fit on the distribution, not generalization to
         # unseen text — use --eval-dataset for a genuinely held-out corpus.
+        n_requested = config.eval_samples or 64
+        n_batches = max((n_requested + batch - 1) // batch, 1)
         eval_ds = SyntheticTextDataset(
-            num_samples=config.eval_samples,
+            num_samples=n_batches * batch,
             seq_len=config.sequence_length,
             vocab_size=model_config.vocab_size,
             seed=config.seed + 1,
         )
-    batch = min(config.batch_size, len(eval_ds))
-    n_batches = max(len(eval_ds) // batch, 1)
     eval_step = make_eval_step(model_config, config.loss_chunk_size)
 
     def run_eval(state):

@@ -119,3 +119,41 @@ def test_sharded_parquet_dir_and_glob(tmp_path):
 
     with pytest.raises(FileNotFoundError):
         ParquetTextDataset(str(d / "nope-*.parquet"), tok, seq_len=8)
+
+
+@pytest.mark.skipif(not HAVE_TOKENIZERS, reason="tokenizers not installed")
+def test_eval_on_parquet_corpus(parquet_file, tmp_path, caplog):
+    """--eval-dataset points at a parquet corpus: the eval loop tokenizes
+    with the eval dataset's own pad id and logs held-out losses."""
+    import logging
+
+    from pyrecover_tpu.config import TrainConfig
+    from pyrecover_tpu.models import ModelConfig
+    from pyrecover_tpu.train import train
+    from pyrecover_tpu.utils.logging import init_logger
+
+    cfg = TrainConfig(
+        sequence_length=16, batch_size=8, training_samples=16,
+        training_steps=2, checkpoint_dir=str(tmp_path),
+        checkpoint_frequency=-1, experiment_name="pe",
+        eval_frequency=1, eval_samples=4, eval_dataset=str(parquet_file),
+        tokenizer_name_or_path="",  # monkeypatched below
+    )
+    cfg.model = ModelConfig().tiny(max_seq_len=16, vocab_size=128)
+    cfg.__post_init__()
+
+    # inject the tiny whitespace tokenizer instead of downloading one
+    import pyrecover_tpu.data.parquet as parquet_mod
+
+    orig = parquet_mod.load_tokenizer
+    parquet_mod.load_tokenizer = lambda name: make_tokenizer()
+    logger = init_logger()
+    logger.propagate = True
+    try:
+        with caplog.at_level(logging.INFO, logger="pyrecover_tpu"):
+            train(cfg)
+    finally:
+        parquet_mod.load_tokenizer = orig
+        logger.propagate = False
+    evals = [r for r in caplog.records if "eval | step" in r.getMessage()]
+    assert len(evals) == 2
