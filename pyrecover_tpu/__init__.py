@@ -19,3 +19,32 @@ implemented.
 from pyrecover_tpu.version import __version__
 
 __all__ = ["__version__"]
+
+
+def _honor_jax_platforms_env():
+    """Container images that register an accelerator PJRT plugin from
+    ``sitecustomize`` may also override jax's platform CONFIG, silently
+    defeating a ``JAX_PLATFORMS`` environment variable set by the caller —
+    and a subprocess that was told ``JAX_PLATFORMS=cpu`` (tests, CI, the
+    launcher's smoke runs) then hangs trying to reach an accelerator that
+    isn't there. Re-assert the environment's intent here, which runs at
+    the top of every entry point, while it is still safe to do so (no
+    backend client created yet)."""
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    try:
+        import jax
+        import jax._src.xla_bridge as _xb
+
+        if getattr(_xb, "_backends", None):
+            return  # a backend is already live; switching would invalidate it
+        if jax.config.jax_platforms != want:
+            jax.config.update("jax_platforms", want)
+    except Exception:
+        pass  # never let platform fixup break an import
+
+
+_honor_jax_platforms_env()

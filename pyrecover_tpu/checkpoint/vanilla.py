@@ -10,12 +10,22 @@ keeps the newest N, and `latest` is discoverable. TPU-native differences:
     state, step/epoch, RNG key data) + the sampler's data-order state — so a
     resume is bit-exact by construction. The reference loses sampler state
     silently (SURVEY §2.3 defect 3) and never saves RNG.
-  * Serialization is flat msgpack of the pytree leaves (numpy), written
-    atomically (tmp file + rename) so a preemption mid-write can never
-    corrupt `latest` — the reference writes in place.
+  * Serialization STREAMS leaf-by-leaf (format v2: a JSON header with
+    per-leaf dtype/shape followed by length-prefixed raw buffers) with the
+    checksum folded into the same write pass, so host-0 RAM is bounded by
+    O(largest leaf) on a synchronous save — leaves are gathered, written,
+    and freed one at a time — instead of the v1 msgpack path's whole-state
+    payload copy on top of the gathered leaves (≈4× state bytes at the 8B
+    flagship; the reference's `torch.save` streams, checkpoint.py:74).
+    Background saves must gather on the calling thread (collectives can't
+    run concurrently with training), so they hold the gathered state once
+    and decay it leaf-by-leaf as the writer drains. Writes are atomic
+    (tmp file + rename) so a preemption mid-write can never corrupt
+    `latest` — the reference writes in place. v1 checkpoints remain
+    readable.
   * Multi-host: non-addressable (sharded) leaves are allgathered to host 0;
     on load every host reads the file and `device_put`s onto its target
-    shardings. SHA-256 replaces MD5.
+    shardings. SHA-256/xxh64-tree replaces MD5.
 """
 
 import hashlib
@@ -28,13 +38,15 @@ from pathlib import Path
 
 import jax
 import numpy as np
-from flax.serialization import msgpack_restore, msgpack_serialize
+from flax.serialization import msgpack_restore
 
 from pyrecover_tpu.checkpoint.registry import prune_checkpoints
 from pyrecover_tpu.parallel.mesh import sync_global_devices
 from pyrecover_tpu.utils.logging import log_host0
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2
+SUPPORTED_FORMATS = (1, 2)  # v1 (msgpack) stays readable
+MAGIC = b"PYRCKPT2"
 
 
 def _leaf_to_numpy(leaf):
@@ -43,6 +55,17 @@ def _leaf_to_numpy(leaf):
 
         return np.asarray(multihost_utils.process_allgather(leaf, tiled=True))
     return np.asarray(leaf)
+
+
+def _dtype_from_str(s):
+    """np dtype from its str() name, including the ml_dtypes family
+    (bfloat16 etc.) that np.dtype() alone doesn't resolve."""
+    try:
+        return np.dtype(s)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, s))
 
 
 _HASH_CHUNK = 16 * 1024 * 1024
@@ -98,6 +121,46 @@ def _sidecar(path):
     return p.with_suffix(p.suffix + ".sha256")
 
 
+class _IncrementalChecksum:
+    """Folds the sidecar checksum into the streaming write pass (no
+    re-read of the file): the native xxh64-tree scheme when the C++
+    engine is available — per-_HASH_CHUNK digests over the byte stream,
+    combined at the end, byte-identical to ``hash_file`` — else streaming
+    sha256. Both produce strings ``verify_checksum`` accepts."""
+
+    def __init__(self, chunk=_HASH_CHUNK):
+        from pyrecover_tpu.checkpoint import native_io
+
+        self.chunk = chunk
+        self.native = native_io.available()
+        if self.native:
+            self._xxh = native_io.xxh64
+            self._buf = bytearray()
+            self._digests = []
+        else:
+            self._h = hashlib.sha256()
+
+    def update(self, data):
+        if not self.native:
+            self._h.update(data)
+            return
+        self._buf += data
+        while len(self._buf) >= self.chunk:
+            self._digests.append(
+                self._xxh(bytes(self._buf[: self.chunk])).to_bytes(8, "little")
+            )
+            del self._buf[: self.chunk]
+
+    def result(self):
+        if not self.native:
+            return f"sha256::{self._h.hexdigest()}"
+        if self._buf or not self._digests:
+            self._digests.append(self._xxh(bytes(self._buf)).to_bytes(8, "little"))
+            self._buf = bytearray()
+        digest = self._xxh(b"".join(self._digests))
+        return f"xxh64tree:{self.chunk}:{digest:016x}"
+
+
 class VanillaSaveHandle:
     """Handle for a background vanilla save. ``wait()`` re-raises any write
     error. Only the serialize/write half runs in the thread; everything
@@ -128,38 +191,59 @@ def save_ckpt_vanilla(path, state, sampler_state=None, *, verify=False,
     (train.py:332-340). With ``background=True`` returns
     ``(blocking_seconds, VanillaSaveHandle)``: the device→host gather and
     cross-host barrier stay on the calling thread (collectives must never
-    run concurrently), while serialization, file write, checksum, and
-    retention pruning — pure host-0-local work — overlap subsequent
-    training steps. The reference's vanilla save stalls every rank for the
-    full write (checkpoint.py:55-103); this one stalls only for the gather.
+    run concurrently), while the streaming write, checksum, and retention
+    pruning — pure host-0-local work — overlap subsequent training steps.
+    The reference's vanilla save stalls every rank for the full write
+    (checkpoint.py:55-103); this one stalls only for the gather.
+
+    Host-0 RAM: synchronous saves INTERLEAVE gather and write, holding one
+    leaf at a time — O(largest leaf). Background saves must finish every
+    gather before returning, so they hold the gathered state once and free
+    each leaf as the writer drains it.
     """
     t0 = time.monotonic()
     path = Path(path)
     sync_global_devices("vanilla_save_enter")
 
     path_leaves, treedef = jax.tree_util.tree_flatten_with_path(state)
-    # Sharded leaves are allgathered (a collective: every host participates),
-    # but only host 0 KEEPS the numpy copies — non-zero hosts drop each leaf
-    # as soon as the gather returns, bounding their extra host RAM to one
-    # leaf instead of the full state (~full-model × fp32 per host at 8B).
-    is_host0 = jax.process_index() == 0
-    np_leaves = []
-    for _, x in path_leaves:
-        arr = _leaf_to_numpy(x)
-        np_leaves.append(arr if is_host0 else None)
-        del arr
     keystrs = [jax.tree_util.keystr(p) for p, _ in path_leaves]
+    meta = {
+        "format": FORMAT_VERSION,
+        "num_leaves": len(path_leaves),
+        "treedef": str(treedef),
+        # leaf key-paths, for the equality CLI and cross-format comparison
+        "paths": keystrs,
+        "sampler": sampler_state or {},
+        # per-leaf dtype/shape: the v2 frame decoder's index
+        "leaves": [
+            {"dtype": str(np.dtype(x.dtype)), "shape": list(x.shape)}
+            for _, x in path_leaves
+        ],
+    }
+    if extra_meta:
+        meta.update(extra_meta)
+    is_host0 = jax.process_index() == 0
 
     if background:
+        # gather NOW (collectives stay on the calling thread); only host 0
+        # keeps the numpy copies, and the writer frees each one as written
+        np_leaves = []
+        for _, x in path_leaves:
+            arr = _leaf_to_numpy(x)
+            np_leaves.append(arr if is_host0 else None)
+            del arr
         handle = VanillaSaveHandle()
-        if jax.process_index() == 0:
+        if is_host0:
+
+            def drain():
+                for i in range(len(np_leaves)):
+                    arr = np_leaves[i]
+                    np_leaves[i] = None  # decay RAM as the write advances
+                    yield arr
 
             def _bg():
                 try:
-                    _serialize_and_write(
-                        path, np_leaves, keystrs, str(treedef), sampler_state,
-                        extra_meta, verify, max_keep,
-                    )
+                    _write_stream(path, drain(), meta, verify, max_keep)
                 except BaseException as e:  # surfaced at wait()
                     handle.error = e
 
@@ -170,54 +254,62 @@ def save_ckpt_vanilla(path, state, sampler_state=None, *, verify=False,
         # host-0-local, so other hosts have nothing to wait for
         return time.monotonic() - t0, handle
 
-    if jax.process_index() == 0:
-        _serialize_and_write(
-            path, np_leaves, keystrs, str(treedef), sampler_state, extra_meta,
+    # synchronous: interleave gather → write → free, one leaf live at a
+    # time. Every host walks the SAME leaf order so the allgather
+    # collectives line up; non-zero hosts drop each leaf immediately.
+    if is_host0:
+        _write_stream(
+            path, (_leaf_to_numpy(x) for _, x in path_leaves), meta,
             verify, max_keep,
         )
+    else:
+        for _, x in path_leaves:
+            arr = _leaf_to_numpy(x)
+            del arr
 
     sync_global_devices("vanilla_save_exit")
     return time.monotonic() - t0
 
 
-def _serialize_and_write(path, np_leaves, keystrs, treedef_str, sampler_state,
-                         extra_meta, verify, max_keep):
+def _write_stream(path, leaves_iter, meta, verify, max_keep):
+    """Stream the v2 container: MAGIC, u64 meta length, meta JSON, then per
+    leaf a u64 byte length + the raw little-endian C-order buffer. The
+    sidecar checksum is computed over the same byte stream in-pass (no
+    re-read). Leaves are written through a zero-copy uint8 view (numpy's
+    buffer protocol rejects ml_dtypes like bfloat16, so the view is taken
+    after reinterpreting the buffer as uint8), so peak extra RAM is the
+    checksum's chunk buffer — plus a one-leaf copy only if a leaf arrives
+    non-contiguous."""
     path.parent.mkdir(parents=True, exist_ok=True)
-    meta = {
-        "format": FORMAT_VERSION,
-        "num_leaves": len(np_leaves),
-        "treedef": treedef_str,
-        # leaf key-paths, for the equality CLI and cross-format comparison
-        "paths": keystrs,
-        "sampler": sampler_state or {},
-    }
-    if extra_meta:
-        meta.update(extra_meta)
-    payload = msgpack_serialize(
-        {
-            "meta": json.dumps(meta),
-            "leaves": {str(i): leaf for i, leaf in enumerate(np_leaves)},
-        }
-    )
-    from pyrecover_tpu.checkpoint import native_io
-
+    meta_b = json.dumps(meta).encode()
+    checksum = _IncrementalChecksum() if verify else None
     fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=path.name, suffix=".tmp")
-    checksum = None
     try:
-        if native_io.available():
-            # parallel pwrite + checksum computed in the same pass
-            os.close(fd)
-            digest = native_io.write_file(tmp, payload, chunk=_HASH_CHUNK)
-            checksum = f"xxh64tree:{_HASH_CHUNK}:{digest:016x}"
-        else:
-            with os.fdopen(fd, "wb") as f:
-                f.write(payload)
+        with os.fdopen(fd, "wb", buffering=4 * 1024 * 1024) as f:
+
+            def w(b):
+                f.write(b)
+                if checksum is not None:
+                    checksum.update(b)
+
+            w(MAGIC)
+            w(len(meta_b).to_bytes(8, "little"))
+            w(meta_b)
+            for arr in leaves_iter:
+                data = memoryview(
+                    np.ascontiguousarray(arr).view(np.uint8)
+                ).cast("B")
+                del arr
+                w(len(data).to_bytes(8, "little"))
+                for off in range(0, len(data), _HASH_CHUNK):
+                    w(data[off : off + _HASH_CHUNK])
+                del data
         os.replace(tmp, path)  # atomic publish
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
     if verify:
-        _sidecar(path).write_text(checksum or compute_checksum(path))
+        _sidecar(path).write_text(checksum.result())
     if max_keep:
         prune_checkpoints(path.parent, max_keep, sharded=False)
 
@@ -227,6 +319,8 @@ def read_ckpt_raw(path, *, check_version=True):
     ``(meta, paths, leaves)`` where ``paths`` are leaf key-path strings and
     ``leaves`` are numpy arrays in tree-flatten order. The single decoder of
     the on-disk layout — the equality CLI and the inspector build on it.
+    Decodes both the v2 framed container (zero-copy views into the read
+    buffer) and legacy v1 msgpack files.
 
     ``check_version=False`` lets diagnostic tools display/compare
     checkpoints from other format versions on a best-effort basis instead
@@ -238,9 +332,29 @@ def read_ckpt_raw(path, *, check_version=True):
         data, _ = native_io.read_file(path)  # parallel pread
     else:
         data = path.read_bytes()
+    if data[: len(MAGIC)] == MAGIC:
+        off = len(MAGIC)
+        mlen = int.from_bytes(data[off : off + 8], "little")
+        off += 8
+        meta = json.loads(data[off : off + mlen].decode())
+        off += mlen
+        if check_version and meta["format"] not in SUPPORTED_FORMATS:
+            raise ValueError(f"Unsupported checkpoint format {meta['format']}")
+        leaves = []
+        for lm in meta["leaves"]:
+            n = int.from_bytes(data[off : off + 8], "little")
+            off += 8
+            dt = _dtype_from_str(lm["dtype"])
+            count = int(np.prod(lm["shape"], dtype=np.int64)) if lm["shape"] else 1
+            arr = np.frombuffer(data, dtype=dt, count=count, offset=off)
+            leaves.append(arr.reshape(lm["shape"]))
+            off += n
+        paths = meta.get("paths") or [f"leaf{i}" for i in range(len(leaves))]
+        return meta, paths, leaves
+    # legacy v1: flat msgpack of {"meta": json, "leaves": {i: array}}
     raw = msgpack_restore(data)
     meta = json.loads(raw["meta"])
-    if check_version and meta["format"] != FORMAT_VERSION:
+    if check_version and meta["format"] not in SUPPORTED_FORMATS:
         raise ValueError(f"Unsupported checkpoint format {meta['format']}")
     leaves = [raw["leaves"][str(i)] for i in range(meta["num_leaves"])]
     paths = meta.get("paths") or [f"leaf{i}" for i in range(len(leaves))]

@@ -29,6 +29,10 @@ class TrainConfig:
     # -- data ----------------------------------------------------------------
     dataset: str = ""  # path to parquet with a 'text' column; "" → synthetic
     tokenizer_name_or_path: str = "unsloth/Mistral-Nemo-Base-2407-bnb-4bit"
+    # pack multiple documents per row (segment-id attention masking) instead
+    # of right-padding each one like the reference (dataset.py:29-35) —
+    # training-tokens % becomes ~100 by construction
+    pack_sequences: bool = False
     sequence_length: int = 2048
     batch_size: int = 1  # GLOBAL batch size (reference train.py:62-63 semantics)
     training_samples: int = 0  # 0 → len(dataset); else wraparound like ref dataset.py:25
@@ -73,8 +77,9 @@ class TrainConfig:
     default_iter_time: float = 1.0
     default_ckpt_time: float = 10.0
     job_end_time: Optional[float] = None  # unix seconds; else $JOB_END_TIME / SLURM_JOB_END_TIME
-    # deadline/notice checks (device sync + cross-host broadcast) run every
-    # k-th step; the safety buffer absorbs the ≤(k-1)-step decision delay
+    # the deadline decision (device sync + cross-host broadcast) runs every
+    # k-th step; the safety buffer absorbs the ≤(k-1)-step decision delay.
+    # Cheap host-local preemption signals are still observed every step.
     preempt_check_interval: int = 5
     # -- evaluation (beyond-parity: the reference has no eval loop) ----------
     eval_frequency: int = 0  # every k steps; 0 disables
@@ -89,6 +94,14 @@ class TrainConfig:
     profile_dir: str = "profiles/"
 
     def __post_init__(self):
+        if self.pack_sequences and (
+            self.attention_impl == "ring" or self.mesh.sequence > 1
+        ):
+            raise ValueError(
+                "--pack-sequences is not supported with ring attention / "
+                "--sp > 1 yet: the ring schedule has no segment-mask path. "
+                "Use sdpa or flash attention."
+            )
         if self.attention_impl == "auto":
             if self.mesh.sequence > 1:
                 attn = "ring"
@@ -122,6 +135,10 @@ def build_parser():
     p.add_argument("--dataset", type=str, default=d.dataset,
                    help="Parquet file with a 'text' column. Empty → deterministic synthetic data.")
     p.add_argument("--tokenizer-name-or-path", type=str, default=d.tokenizer_name_or_path)
+    p.add_argument("--pack-sequences", action="store_true",
+                   help="Pack multiple documents per row (segment-masked "
+                        "attention) instead of right-padding each one; "
+                        "training-tokens %% becomes ~100.")
     p.add_argument("--sequence-length", type=int, default=d.sequence_length)
     p.add_argument("--batch-size", type=int, default=d.batch_size,
                    help="GLOBAL batch size, sharded over the data axis.")
@@ -262,6 +279,7 @@ def get_args(argv=None):
     return TrainConfig(
         dataset=ns.dataset,
         tokenizer_name_or_path=ns.tokenizer_name_or_path,
+        pack_sequences=ns.pack_sequences,
         sequence_length=ns.sequence_length,
         batch_size=ns.batch_size,
         training_samples=ns.training_samples,

@@ -3,6 +3,14 @@
 Parity with reference ``CollatorForCLM`` (dataset.py:38-61): given tokenized
 items of length seq_len+1, inputs are tokens[:-1], labels are tokens[1:]
 with pad positions set to IGNORE_INDEX (-100) so they drop out of the loss.
+
+Packed items (``(tokens, segment_ids)`` tuples from
+``PackedParquetTextDataset``) additionally carry per-position segment ids:
+the label at the last position of each document — which would "predict" the
+next document's first token — is masked, as are padding positions (segment
+``PAD_SEGMENT``). Labels are NOT masked by token value in packed mode: the
+pad token is usually EOS, and EOS is a legitimate prediction target inside
+a packed stream.
 """
 
 import numpy as np
@@ -11,10 +19,25 @@ from pyrecover_tpu.train_state import IGNORE_INDEX
 
 
 def collate_clm(items, pad_token_id):
-    """items: sequence of int32 arrays, each (seq_len + 1,).
+    """items: sequence of int32 arrays, each (seq_len + 1,) — or, packed,
+    of ``(tokens, segment_ids)`` tuples of such arrays.
 
-    Returns dict of numpy arrays: inputs (B, S) int32, labels (B, S) int32.
+    Returns dict of numpy arrays: inputs (B, S) int32, labels (B, S) int32,
+    plus segments (B, S) int32 for packed items.
     """
+    if isinstance(items[0], tuple):
+        from pyrecover_tpu.data.packed import PAD_SEGMENT
+
+        toks = np.stack([t for t, _ in items]).astype(np.int32)
+        segs = np.stack([s for _, s in items]).astype(np.int32)
+        inputs = toks[:, :-1]
+        labels = toks[:, 1:].copy()
+        seg_in = segs[:, :-1].copy()
+        seg_lab = segs[:, 1:]
+        # cross-document predictions and padding drop out of the loss
+        labels[seg_lab != seg_in] = IGNORE_INDEX
+        labels[seg_lab == PAD_SEGMENT] = IGNORE_INDEX
+        return {"inputs": inputs, "labels": labels, "segments": seg_in}
     batch = np.stack(items).astype(np.int32)
     inputs = batch[:, :-1]
     labels = batch[:, 1:].copy()

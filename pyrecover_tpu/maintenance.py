@@ -1,0 +1,173 @@
+"""Cloud TPU maintenance-event watcher: GCE metadata → preemption notice.
+
+This is the producer for the preemption notice that ``preempt.py`` consumes
+— the TPU-native re-sourcing of the reference's per-step deadline poll
+(reference train.py:223-232). On Cloud TPU, evictions that are NOT plain
+SIGTERMs (host maintenance, queued-resource preemption) are announced
+through the per-VM GCE metadata server:
+
+  * ``instance/maintenance-event`` transitions from ``NONE`` to
+    ``TERMINATE_ON_HOST_MAINTENANCE`` (or ``MIGRATE_ON_HOST_MAINTENANCE``)
+    ahead of the event, and supports HTTP long-polling via
+    ``?wait_for_change=true&last_etag=...`` — the server holds the request
+    open until the value changes, so detection is immediate with zero
+    steady-state traffic.
+  * ``instance/preempted`` flips to ``TRUE`` when a preemptible/spot VM is
+    being reclaimed.
+
+A daemon thread long-polls both; on the first actionable value it invokes
+the callback (which sets ``PreemptionWatcher._signal_seen``) and touches
+the notice file (``$PYRECOVER_PREEMPT_FILE``) so external tooling and the
+launcher see the same signal. The thread is started on host 0 by
+``PreemptionWatcher.start_maintenance_watcher`` when time-aware
+checkpointing is enabled on a TPU platform (or whenever
+``$PYRECOVER_METADATA_BASE`` points at a metadata server — the test hook:
+tests run a fake local HTTP metadata server and preempt a real training
+run with no SIGTERM involved).
+
+Off GCE the very first metadata request fails (DNS/connect error) and the
+watcher retires itself after a few quiet retries — no noise, no thread
+left spinning.
+"""
+
+import os
+import threading
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+from pyrecover_tpu.utils.logging import log_host0
+
+# Default per GCE contract; tests override via $PYRECOVER_METADATA_BASE.
+METADATA_BASE_ENV = "PYRECOVER_METADATA_BASE"
+DEFAULT_METADATA_BASE = "http://metadata.google.internal/computeMetadata/v1"
+_METADATA_HEADERS = {"Metadata-Flavor": "Google"}
+
+# maintenance-event values that mean "save now". MIGRATE is included: TPU
+# VMs can't live-migrate, so any announced host maintenance is a terminate
+# from the training job's point of view.
+_ACTIONABLE = ("TERMINATE_ON_HOST_MAINTENANCE", "MIGRATE_ON_HOST_MAINTENANCE")
+
+
+def metadata_base():
+    return os.environ.get(METADATA_BASE_ENV) or DEFAULT_METADATA_BASE
+
+
+class MaintenanceEventWatcher:
+    """Daemon thread long-polling the GCE metadata maintenance endpoints.
+
+    Args:
+      on_event: callable invoked once (from the watcher thread) with the
+        event description string when an actionable event is observed.
+      notice_file: optional path touched on the event — the file-based
+        notice protocol shared with the launcher and ``preempt.py``.
+      base: metadata server base URL (default: GCE's, or
+        ``$PYRECOVER_METADATA_BASE``).
+      poll_timeout_s: long-poll hold time per request; also the error
+        retry backoff ceiling. The loop alternates a plain
+        ``instance/preempted`` read with one ``maintenance-event``
+        long-poll of this hold time, so a spot reclaim that flips
+        ``preempted`` mid-poll is observed within ~poll_timeout_s — the
+        default 10 s keeps that blind window well inside GCE's ~30 s spot
+        shutdown grace (maintenance events long-poll instantly either way).
+    """
+
+    def __init__(self, on_event=None, notice_file=None, base=None,
+                 poll_timeout_s=10, max_consecutive_errors=3):
+        self.on_event = on_event
+        self.notice_file = Path(notice_file) if notice_file else None
+        self.base = (base or metadata_base()).rstrip("/")
+        self.poll_timeout_s = poll_timeout_s
+        self.max_consecutive_errors = max_consecutive_errors
+        self.event_seen = None  # description string once fired
+        self._stop = threading.Event()
+        self._thread = None
+
+    # -- metadata I/O --------------------------------------------------------
+    def _get(self, rel, *, etag=None, timeout):
+        """One metadata GET. With ``etag`` this is a hanging long-poll that
+        returns only when the value changes (or the server-side timeout
+        lapses). Returns (body, etag)."""
+        url = f"{self.base}/{rel}"
+        if etag is not None:
+            sep = "&" if "?" in url else "?"
+            url = (
+                f"{url}{sep}wait_for_change=true&last_etag={etag}"
+                f"&timeout_sec={self.poll_timeout_s}"
+            )
+        req = urllib.request.Request(url, headers=_METADATA_HEADERS)
+        # client timeout > server hold time so the server, not the socket,
+        # ends a quiet long-poll
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return (
+                resp.read().decode("utf-8", "replace").strip(),
+                resp.headers.get("ETag"),
+            )
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="maintenance-event-watcher", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    @property
+    def alive(self):
+        return self._thread is not None and self._thread.is_alive()
+
+    # -- the poll loop -------------------------------------------------------
+    def _fire(self, description):
+        if self.event_seen is not None:
+            return
+        self.event_seen = description
+        log_host0(
+            "Maintenance/preemption notice from metadata server: %s — "
+            "triggering final checkpoint", description,
+        )
+        if self.notice_file is not None:
+            try:
+                self.notice_file.parent.mkdir(parents=True, exist_ok=True)
+                self.notice_file.write_text(description)
+            except OSError as e:
+                log_host0("could not write notice file %s: %s",
+                          self.notice_file, e)
+        if self.on_event is not None:
+            self.on_event(description)
+
+    def _run(self):
+        errors = 0
+        etag = None
+        while not self._stop.is_set() and self.event_seen is None:
+            try:
+                # preempted is a plain read (no etag churn): spot/queued-
+                # resource reclaims flip it without a maintenance-event
+                val, _ = self._get("instance/preempted", timeout=10)
+                if val.upper() == "TRUE":
+                    self._fire("instance/preempted=TRUE")
+                    return
+                # hanging long-poll on maintenance-event; first call (no
+                # etag) returns immediately with the current value+etag
+                val, etag = self._get(
+                    "instance/maintenance-event", etag=etag,
+                    timeout=self.poll_timeout_s + 30,
+                )
+                errors = 0
+                if val.upper() in _ACTIONABLE:
+                    self._fire(f"instance/maintenance-event={val}")
+                    return
+            except (urllib.error.URLError, OSError, ValueError):
+                # no metadata server (not on GCE) or a transient failure
+                errors += 1
+                if errors >= self.max_consecutive_errors:
+                    log_host0(
+                        "metadata server unreachable after %d attempts; "
+                        "maintenance-event watcher retiring (SIGTERM/notice-"
+                        "file preemption signals remain active)", errors,
+                    )
+                    return
+                self._stop.wait(min(2.0**errors, self.poll_timeout_s))

@@ -44,9 +44,19 @@ class LossCSVLogger:
             if append:
                 with open(path, newline="") as f:
                     rows = list(csv.reader(f))
-                kept = [rows[0]] + [
-                    r for r in rows[1:] if r and int(r[0]) <= resume_step
-                ]
+                # a kill mid-write can leave a torn final row (or torn
+                # file): drop rows that don't parse instead of refusing to
+                # resume — the CSV is observability, not state
+                kept = [rows[0] if rows else ["step", "loss"]]
+                for r in rows[1:]:
+                    try:
+                        # both fields must parse — a torn row can lose the
+                        # loss column while keeping a valid step
+                        if len(r) >= 2 and int(r[0]) <= resume_step:
+                            float(r[1])
+                            kept.append(r)
+                    except ValueError:
+                        continue
                 with open(path, "w", newline="") as f:
                     csv.writer(f).writerows(kept)
             self._file = open(path, "a" if append else "w", newline="")

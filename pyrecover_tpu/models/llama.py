@@ -71,7 +71,7 @@ class ModelConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01  # load-balance loss scale
     moe_ffn_hidden: int = 0  # per-expert hidden size; 0 → ffn_hidden_dim
-    moe_dispatch: str = "auto"  # "auto" | "einsum" | "scatter" (see moe.py)
+    moe_dispatch: str = "auto"  # "auto" | "grouped" | "einsum" | "scatter" (moe.py)
 
     def __post_init__(self):
         if self.n_experts > 0 and self.moe_top_k > self.n_experts:
@@ -197,11 +197,12 @@ def _attention_fn(config):
     return sdpa_attention
 
 
-def _block(x, layer, cos, sin, config, attn_fn):
+def _block(x, layer, cos, sin, config, attn_fn, segment_ids=None):
     """One pre-norm transformer block (reference model.py:272-327).
 
     Returns ``(x, aux)`` where aux is the per-row MoE load-balance loss
-    ((B,) f32; zeros for dense FFN layers).
+    ((B,) f32; zeros for dense FFN layers). ``segment_ids`` (B, S) carries
+    packed-sequence boundaries into the attention mask.
     """
     cfg = config
     cdt = resolve_dtype(cfg.compute_dtype)
@@ -221,7 +222,10 @@ def _block(x, layer, cos, sin, config, attn_fn):
     q = constrain(q, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, AXIS_TENSOR, None)
     k = constrain(k, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, AXIS_TENSOR, None)
     v = constrain(v, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, AXIS_TENSOR, None)
-    attn = attn_fn(q, k, v, causal=True)
+    if segment_ids is None:
+        attn = attn_fn(q, k, v, causal=True)
+    else:
+        attn = attn_fn(q, k, v, causal=True, segment_ids=segment_ids)
     attn = checkpoint_name(attn, "attn_out")
     attn = attn.reshape(b, s, cfg.n_heads * hd)
     x = x + attn @ layer["wo"].astype(cdt)
@@ -246,7 +250,7 @@ def _block(x, layer, cos, sin, config, attn_fn):
     return x, aux
 
 
-def forward_hidden_with_aux(params, tokens, config):
+def forward_hidden_with_aux(params, tokens, config, segment_ids=None):
     """Embed → n_layers pre-norm blocks → final RMSNorm; returns
     ``(hidden, aux)``: the hidden states (batch, seq, dim) BEFORE the vocab
     projection (split out so the loss can fuse projection + cross-entropy
@@ -254,7 +258,8 @@ def forward_hidden_with_aux(params, tokens, config):
     logits — an HBM optimization the reference, which always materializes
     full logits at train.py:262-266, has no analogue of), and the scalar
     MoE load-balance aux loss summed over layers, averaged over rows
-    (0 for dense models)."""
+    (0 for dense models). ``segment_ids`` (batch, seq) enables packed-
+    sequence attention masking (``--pack-sequences``)."""
     cfg = config
     cdt = resolve_dtype(cfg.compute_dtype)
     seq_len = tokens.shape[1]
@@ -276,12 +281,16 @@ def forward_hidden_with_aux(params, tokens, config):
 
     block = partial(_block, cos=cos, sin=sin, config=cfg, attn_fn=attn_fn)
 
-    # Carry = {"x": activations, "aux": per-row aux accumulator}. Per-row
-    # (not scalar) so pipeline microbatching splits it along the batch like
-    # everything else and the result is identical with and without PP.
+    # Carry = {"x": activations, "aux": per-row aux accumulator, and — when
+    # packing — "seg": the per-row segment ids}. Everything per-row so
+    # pipeline microbatching splits the carry along the batch like
+    # everything else and the result is identical with and without PP
+    # (segment ids ride the carry rather than a closure for exactly that
+    # reason: a closed-over full-batch array would not be microbatched).
     def block_carry(carry, layer):
-        new_x, aux = block(carry["x"], layer)
-        return {"x": new_x, "aux": carry["aux"] + aux}
+        new_x, aux = block(carry["x"], layer, segment_ids=carry.get("seg"))
+        out = dict(carry, x=new_x, aux=carry["aux"] + aux)
+        return out
 
     if cfg.remat:
         policy = (
@@ -300,6 +309,8 @@ def forward_hidden_with_aux(params, tokens, config):
         "x": x,
         "aux": jnp.zeros((x.shape[0],), dtype=jnp.float32),
     }
+    if segment_ids is not None:
+        carry["seg"] = segment_ids.astype(jnp.int32)
     carry = pipeline_blocks(
         params["layers"], carry, block_carry,
         n_microbatches=cfg.pp_microbatches,
@@ -309,9 +320,9 @@ def forward_hidden_with_aux(params, tokens, config):
     return hidden, jnp.mean(carry["aux"])
 
 
-def forward_hidden(params, tokens, config):
+def forward_hidden(params, tokens, config, segment_ids=None):
     """`forward_hidden_with_aux` without the aux loss (dense callers)."""
-    return forward_hidden_with_aux(params, tokens, config)[0]
+    return forward_hidden_with_aux(params, tokens, config, segment_ids)[0]
 
 
 def project_vocab(params, hidden, config):
@@ -324,7 +335,7 @@ def project_vocab(params, hidden, config):
     return constrain(logits, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, AXIS_TENSOR)
 
 
-def forward(params, tokens, config):
+def forward(params, tokens, config, segment_ids=None):
     """Forward pass: tokens (batch, seq) int32 → logits (batch, seq, vocab) fp32.
 
     Mirrors reference `Transformer.forward` (model.py:376-395): embed →
@@ -332,4 +343,6 @@ def forward(params, tokens, config):
     Logits are returned in fp32 (the reference casts in its loss,
     train.py:263-266).
     """
-    return project_vocab(params, forward_hidden(params, tokens, config), config)
+    return project_vocab(
+        params, forward_hidden(params, tokens, config, segment_ids), config
+    )

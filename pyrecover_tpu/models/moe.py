@@ -27,11 +27,22 @@ construction — rank-and-scatter dispatch over static shapes:
 Top-k routing renormalizes the selected gate probabilities (Mixtral-style);
 the aux loss is the Switch load-balance loss ``E · Σ_e f_e·p_e`` per row.
 
-Two dispatch backends share these semantics (pinned equal by tests):
-``_moe_ffn_impl`` (sort/scatter — the fast path) everywhere GSPMD manages
-the whole mesh, and ``_moe_ffn_einsum`` (masked one-hot einsums) inside
-manual regions (pipeline stages), where the partitioner cannot handle
-batch-sharded index ops. ``moe_ffn`` picks automatically.
+Three dispatch backends share these semantics (pinned equal by tests):
+
+  * ``_moe_ffn_grouped`` — the MXU path: each row's (token, slot) picks are
+    sorted by expert and the expert FFNs run as ragged grouped matmuls
+    (``jax.lax.ragged_dot_general``) over contiguous expert groups. No
+    capacity-padded slot tensor, no scatter serialization — the MXU sees
+    one dense GEMM per expert sized by its actual load. Default wherever
+    the expert axis is unsharded.
+  * ``_moe_ffn_impl`` (rank-and-scatter) — the EP path: static (B,E,C,D)
+    dispatch whose ``expert``-axis constrain turns into all-to-alls.
+  * ``_moe_ffn_einsum`` (masked one-hot einsums) — inside manual regions
+    (pipeline stages), where the partitioner cannot handle batch-sharded
+    index ops; and small-shape EP, where 0/1 dispatch einsums beat
+    scatters.
+
+``moe_ffn`` picks automatically.
 """
 
 import math
@@ -60,8 +71,9 @@ def moe_ffn(h, router_w, w1, w3, w2, config):
     masked-einsum form inside manual regions — XLA's SPMD partitioner
     CHECK-fails (spmd_partitioner_util.cc device-group computation) on
     gathers whose indices derive from batch-sharded operands there, and
-    einsums are the one form every partitioner handles — otherwise
-    einsum-vs-scatter by the estimated slot-tensor size. In all cases the
+    einsums are the one form every partitioner handles; grouped ragged
+    GEMMs when the expert axis is unsharded (the MXU path); otherwise
+    einsum-vs-scatter by the estimated per-device slot-tensor size, whose
     (B,E,C,D) constrain turns dispatch into all-to-alls over the
     ``expert`` axis.
 
@@ -89,7 +101,31 @@ def moe_ffn(h, router_w, w1, w3, w2, config):
             # dispatch there: expressible entirely as einsums, compiles
             # everywhere, numerically pinned to the scatter path by tests.
             return _moe_ffn_einsum(h, router_w, w1, w3, w2, config)
+    ep = 1
+    if mesh is not None and not mesh.empty:
+        ep = mesh.shape.get(AXIS_EXPERT, 1)
     choice = config.moe_dispatch
+    if choice == "auto" and ep == 1:
+        # Grouped ragged GEMMs whenever the expert axis is unsharded: the
+        # per-row sort/gather keeps data/fsdp sharding intact, and the
+        # expert FFNs run as dense per-expert matmuls on the MXU (measured
+        # v5e moe-4x1b fwd+bwd: grouped ~2.1x the scatter path's step rate
+        # — the 34.5%-active-MFU shortfall BENCH_r03 exposed). With ep > 1
+        # keep the scatter/einsum forms, whose (B,E,C,D) constrain is what
+        # turns dispatch into all-to-alls over the expert axis.
+        return _moe_ffn_grouped(h, router_w, w1, w3, w2, config)
+    if choice == "grouped":
+        if ep > 1:
+            # the grouped path has no expert-axis dispatch constrain, so
+            # GSPMD would allgather the expert-sharded weights onto every
+            # device — silently un-sharding EP. Refuse rather than degrade.
+            raise ValueError(
+                "moe_dispatch='grouped' is incompatible with an expert-"
+                f"sharded mesh (ep={ep}): the ragged-GEMM dispatch cannot "
+                "express expert all-to-alls. Use 'auto', 'scatter', or "
+                "'einsum' with --ep > 1."
+            )
+        return _moe_ffn_grouped(h, router_w, w1, w3, w2, config)
     if choice == "auto":
         # Measured on v5e (8x150m, S=1024, fwd+bwd per MoE layer): einsum
         # 5.3 ms vs scatter 7.5 ms — 0/1 dispatch einsums ride the MXU at
@@ -103,6 +139,13 @@ def moe_ffn(h, router_w, w1, w3, w2, config):
             S, config.n_experts, config.moe_top_k, config.moe_capacity_factor
         )
         slot_elems = B * S * config.moe_top_k * config.n_experts * C
+        # the slot tensor is batch-sharded over data×fsdp: compare the
+        # PER-DEVICE size to the threshold, or large meshes flip to the
+        # slower-at-that-scale scatter path long before ~256 MB/device
+        if mesh is not None and not mesh.empty:
+            slot_elems //= max(
+                mesh.shape.get(AXIS_DATA, 1) * mesh.shape.get(AXIS_FSDP, 1), 1
+            )
         choice = "einsum" if slot_elems <= 64 * 1024 * 1024 else "scatter"
     if choice == "einsum":
         return _moe_ffn_einsum(h, router_w, w1, w3, w2, config)
@@ -175,6 +218,80 @@ def _moe_ffn_impl(h, router_w, w1, w3, w2, config):
     f_e = jnp.sum(onehot, axis=1).astype(f32) / N  # (B,E) pre-capacity
     p_e = probs.mean(axis=1)  # (B,E)
     aux = E * jnp.sum(f_e * p_e, axis=-1)  # (B,) f32
+    return y.astype(h.dtype), aux
+
+
+def _moe_ffn_grouped(h, router_w, w1, w3, w2, config):
+    """Grouped-GEMM dispatch: expert-sorted tokens through ragged matmuls.
+
+    Each row's N = S·K (token, slot) picks are stably argsorted by expert
+    id, giving contiguous per-expert runs whose lengths (the pre-capacity
+    routing histogram) are the ragged ``group_sizes``. The three expert
+    projections then run as ``jax.lax.ragged_dot_general`` calls — one
+    dense MXU GEMM per expert, sized by that expert's actual load, with no
+    (B,E,C,D) capacity padding and no serializing scatters. Dropped picks
+    (rank ≥ C) keep their sorted position but are zeroed: a zero row
+    through SwiGLU is exactly zero (silu(0)·0 = 0), and their gate weight
+    is zeroed in the combine, so semantics stay identical to the other
+    backends (equality-pinned by tests). Everything is per-row, so batch
+    sharding over data/fsdp passes through untouched; expert-sharded
+    meshes (ep > 1) use the scatter/einsum backends instead, whose
+    dispatch constrain is what produces the expert all-to-alls.
+    """
+    cfg = config
+    B, S, D = h.shape
+    E, K = cfg.n_experts, cfg.moe_top_k
+    C = moe_capacity(S, E, K, cfg.moe_capacity_factor)
+    N = S * K
+    f32 = jnp.float32
+
+    # --- routing: identical math to the scatter backend ---
+    logits = jnp.einsum("bsd,de->bse", h.astype(f32), router_w.astype(f32))
+    probs = jax.nn.softmax(logits, axis=-1)  # (B,S,E)
+    gate_vals, gate_idx = jax.lax.top_k(probs, K)  # (B,S,K)
+    gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+    eids = gate_idx.reshape(B, N)
+    gvals = gate_vals.reshape(B, N)
+    onehot = (
+        eids[:, :, None] == jnp.arange(E, dtype=eids.dtype)[None, None, :]
+    ).astype(jnp.int32)  # (B,N,E)
+    prio = jnp.cumsum(onehot, axis=1) - onehot
+    rank = jnp.sum(prio * onehot, axis=-1)  # (B,N)
+    valid = rank < C
+
+    # --- expert-sort each row's picks; group sizes = routing histogram
+    # (pre-capacity: overflow picks stay in their group as zero rows, so
+    # the sizes sum to N exactly) ---
+    cdt = h.dtype
+    order = jnp.argsort(eids, axis=1, stable=True)  # (B,N) pick ids by expert
+    tok_sorted = order // K  # pick n came from token n // K
+    x = jnp.take_along_axis(h, tok_sorted[..., None], axis=1)  # (B,N,D)
+    valid_sorted = jnp.take_along_axis(valid, order, axis=1)
+    x = x * valid_sorted[..., None].astype(cdt)
+    group_sizes = jnp.sum(onehot, axis=1).astype(jnp.int32)  # (B,E)
+
+    rdn = jax.lax.RaggedDotDimensionNumbers(
+        dot_dimension_numbers=(((2,), (1,)), ((), ())),
+        lhs_ragged_dimensions=[1],
+        rhs_group_dimensions=[0],
+    )
+    gate = jax.nn.silu(
+        jax.lax.ragged_dot_general(x, w1.astype(cdt), group_sizes, rdn)
+    )
+    up = jax.lax.ragged_dot_general(x, w3.astype(cdt), group_sizes, rdn)
+    out = jax.lax.ragged_dot_general(
+        gate * up, w2.astype(cdt), group_sizes, rdn
+    )  # (B,N,D), still in expert-sorted order
+
+    # --- unsort and combine with renormalized gates ---
+    inv = jnp.argsort(order, axis=1)  # inverse permutation
+    y_picks = jnp.take_along_axis(out, inv[..., None], axis=1)  # pick order
+    w = jnp.where(valid, gvals, 0.0).astype(cdt)
+    y = jnp.sum((y_picks * w[..., None]).reshape(B, S, K, D), axis=2)
+
+    f_e = jnp.sum(onehot, axis=1).astype(f32) / N  # (B,E) pre-capacity
+    p_e = probs.mean(axis=1)
+    aux = E * jnp.sum(f_e * p_e, axis=-1)
     return y.astype(h.dtype), aux
 
 

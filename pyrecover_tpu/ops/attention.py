@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 
 
-def sdpa_attention(q, k, v, *, causal=True, scale=None):
+def sdpa_attention(q, k, v, *, causal=True, scale=None, segment_ids=None):
     """Scaled dot-product attention with GQA.
 
     Args:
@@ -30,6 +30,10 @@ def sdpa_attention(q, k, v, *, causal=True, scale=None):
       causal: apply a causal mask (queries attend to keys at <= position,
         aligned at the end — standard for q_len == kv_len training).
       scale: optional softmax scale; defaults to 1/sqrt(head_dim).
+      segment_ids: optional (batch, q_len) int32 document/segment ids for
+        packed sequences (requires q_len == kv_len): attention is allowed
+        only within the same segment, so packed documents never attend
+        across their boundaries.
 
     Returns:
       (batch, q_len, n_heads, head_dim) in q.dtype.
@@ -54,6 +58,13 @@ def sdpa_attention(q, k, v, *, causal=True, scale=None):
         kpos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
         mask = qpos >= kpos
         scores = jnp.where(mask, scores, jnp.float32(-1e30))
+    if segment_ids is not None:
+        if sq != sk:
+            raise ValueError("segment_ids requires q_len == kv_len")
+        seg = segment_ids[:, :, None] == segment_ids[:, None, :]  # (b,sq,sk)
+        scores = jnp.where(
+            seg[:, None, None, :, :], scores, jnp.float32(-1e30)
+        )
 
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(

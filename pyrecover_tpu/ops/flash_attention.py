@@ -1,4 +1,4 @@
-"""Pallas flash attention (causal, GQA-aware) with custom VJP.
+"""Pallas flash attention (causal, GQA-aware, packing-aware) with custom VJP.
 
 This is the TPU-native equivalent of the reference's external CUDA
 flash-attention dependency (`setup_flashattention.sh` builds Dao-AILab's
@@ -20,6 +20,14 @@ this accumulator pattern legal. GQA is expressed in the BlockSpec index
 maps (kv head = q head // group) so repeated KV heads are never
 materialized (unlike the reference's repeat_kv, model.py:130-139).
 
+The kernel is TOTAL over shapes: non-divisible sequence lengths get masked
+tail blocks (the ragged edge is iota-masked exactly like the causal
+boundary; Mosaic drops out-of-range stores), any head_dim compiles (Mosaic
+pads the lane dimension — 64/96/128/... all work), and packed sequences are
+supported via per-position ``segment_ids`` folded into the same score mask.
+The only remaining fallback is a malformed GQA config (q heads not a
+multiple of kv heads), and it is LOUD (log_host0), never silent.
+
 Set ``PYRECOVER_PALLAS_INTERPRET=1`` to run in the Pallas interpreter
 (CPU tests — SURVEY §4's fake-backend role).
 """
@@ -29,6 +37,7 @@ import os
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -44,11 +53,65 @@ def _interpret():
     return os.environ.get("PYRECOVER_PALLAS_INTERPRET", "0") == "1"
 
 
+def _score_mask(iq, ik, *, block_q, block_kv, causal, seq_q, seq_kv,
+                sq_ref, sk_ref, mask_q_bound):
+    """(block_q, block_kv) boolean mask of VALID score positions, or None
+    when statically every position in the block is valid. Folds together
+    the causal boundary, the ragged sequence tails (when block size does
+    not divide the length), and packed-sequence segment equality. The
+    q-bound term is only needed where out-of-range q rows would CONTRIBUTE
+    to an accumulation (the dk/dv kernel) — elsewhere their garbage stays
+    in rows whose stores Mosaic drops."""
+    conds = []
+    if causal or seq_kv % block_kv or (mask_q_bound and seq_q % block_q):
+        qpos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0
+        )
+        kpos = ik * block_kv + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1
+        )
+        if causal:
+            conds.append(qpos >= kpos)
+        if seq_kv % block_kv:
+            conds.append(kpos < seq_kv)
+        if mask_q_bound and seq_q % block_q:
+            conds.append(qpos < seq_q)
+    if sq_ref is not None:
+        seg_q = sq_ref[...].reshape(block_q, 1)
+        seg_k = sk_ref[...].reshape(1, block_kv)
+        conds.append(seg_q == seg_k)
+    if not conds:
+        return None
+    mask = conds[0]
+    for c in conds[1:]:
+        mask = mask & c
+    return mask
+
+
+def _zero_oob_rows(x, block_start, valid_len, block):
+    """Zero rows of a (block, d) tile whose global row index falls beyond
+    ``valid_len``. Ragged-tail loads are padding-filled by Mosaic/the
+    interpreter with UNSPECIFIED values (NaN in interpret mode), and a NaN
+    survives multiplication by a zero probability — so any tile that feeds
+    a CONTRACTION over its rows must have its out-of-range rows zeroed
+    explicitly; score masking alone cannot save those products."""
+    if valid_len % block == 0:
+        return x  # statically no ragged tail
+    rows = block_start + jax.lax.broadcasted_iota(jnp.int32, (block, 1), 0)
+    return jnp.where(rows < valid_len, x, 0.0)
+
+
 # =========================== forward kernel ================================
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, block_q, block_kv, causal, num_kv_blocks):
+def _fwd_kernel(*args, scale, block_q, block_kv, causal, num_kv_blocks,
+                seq_q, seq_kv, has_segments):
+    if has_segments:
+        (q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, lse_ref,
+         m_scr, l_scr, acc_scr) = args
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = args
+        sq_ref = sk_ref = None
     iq = pl.program_id(2)
     ik = pl.program_id(3)
 
@@ -68,19 +131,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         q = q_ref[0, 0].astype(jnp.float32)  # (bq, d)
         k = k_ref[0, 0].astype(jnp.float32)  # (bk, d)
         v = v_ref[0, 0].astype(jnp.float32)  # (bk, d)
+        # v feeds the p·v contraction over kv rows: zero its ragged tail
+        v = _zero_oob_rows(v, ik * block_kv, seq_kv, block_kv)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # (bq, bk)
 
-        if causal:
-            qpos = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 0
-            )
-            kpos = ik * block_kv + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 1
-            )
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        mask = _score_mask(
+            iq, ik, block_q=block_q, block_kv=block_kv, causal=causal,
+            seq_q=seq_q, seq_kv=seq_kv, sq_ref=sq_ref, sk_ref=sk_ref,
+            mask_q_bound=False,
+        )
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scr[:, :1]  # (bq, 1)
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -106,7 +170,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         ).astype(jnp.float32)
 
 
-def _fwd(q, k, v, *, causal, scale, block_q, block_kv):
+def _fwd(q, k, v, seg, *, causal, scale, block_q, block_kv):
     b, s, hq, d = q.shape
     _, sk, hkv, _ = k.shape
     group = hq // hkv
@@ -114,6 +178,7 @@ def _fwd(q, k, v, *, causal, scale, block_q, block_kv):
     bk = min(block_kv, sk)
     nq = pl.cdiv(s, bq)
     nk = pl.cdiv(sk, bk)
+    has_segments = seg is not None
 
     # (b, h, s, d) layout for clean 2D blocks
     qt = q.transpose(0, 2, 1, 3)
@@ -122,18 +187,27 @@ def _fwd(q, k, v, *, causal, scale, block_q, block_kv):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, block_q=bq, block_kv=bk,
-        causal=causal, num_kv_blocks=nk,
+        causal=causal, num_kv_blocks=nk, seq_q=s, seq_kv=sk,
+        has_segments=has_segments,
     )
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda bi, hi, qi, ki, g=group: (bi, hi // g, ki, 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda bi, hi, qi, ki, g=group: (bi, hi // g, ki, 0)),
+    ]
+    inputs = [qt, kt, vt]
+    if has_segments:
+        in_specs += [
+            pl.BlockSpec((1, bq), lambda bi, hi, qi, ki: (bi, qi)),
+            pl.BlockSpec((1, bk), lambda bi, hi, qi, ki: (bi, ki)),
+        ]
+        inputs += [seg, seg]
     out, lse = pl.pallas_call(
         kernel,
         grid=(b, hq, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda bi, hi, qi, ki, g=group: (bi, hi // g, ki, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda bi, hi, qi, ki, g=group: (bi, hi // g, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, bq, LSE_LANES),
@@ -149,16 +223,22 @@ def _fwd(q, k, v, *, causal, scale, block_q, block_kv):
             pltpu.VMEM((bq, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(qt, kt, vt)
+    )(*inputs)
     return out.transpose(0, 2, 1, 3), lse
 
 
 # =========================== backward kernels ==============================
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
-                   acc_scr, delta_scr,
-                   *, scale, block_q, block_kv, causal, num_kv_blocks):
+def _bwd_dq_kernel(*args, scale, block_q, block_kv, causal, num_kv_blocks,
+                   seq_q, seq_kv, has_segments):
+    if has_segments:
+        (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, sq_ref, sk_ref,
+         dq_ref, acc_scr, delta_scr) = args
+    else:
+        (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+         dq_ref, acc_scr, delta_scr) = args
+        sq_ref = sk_ref = None
     iq = pl.program_id(2)
     ik = pl.program_id(3)
 
@@ -182,20 +262,23 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
+        # k and v feed contractions over kv rows (ds·k and do·v): zero
+        # their ragged tails so 0-probability NaN products can't leak in
+        k = _zero_oob_rows(k, ik * block_kv, seq_kv, block_kv)
+        v = _zero_oob_rows(v, ik * block_kv, seq_kv, block_kv)
         lse = lse_ref[0, 0][:, :1]
         delta = delta_scr[:, :1]
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        if causal:
-            qpos = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 0
-            )
-            kpos = ik * block_kv + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 1
-            )
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        mask = _score_mask(
+            iq, ik, block_q=block_q, block_kv=block_kv, causal=causal,
+            seq_q=seq_q, seq_kv=seq_kv, sq_ref=sq_ref, sk_ref=sk_ref,
+            mask_q_bound=False,
+        )
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -210,9 +293,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
         dq_ref[0, 0] = acc_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale, block_q, block_kv, causal, num_q_blocks, group):
+def _bwd_dkv_kernel(*args, scale, block_q, block_kv, causal, num_q_blocks,
+                    group, seq_q, seq_kv, has_segments):
+    if has_segments:
+        (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, sq_ref, sk_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = args
+    else:
+        (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = args
+        sq_ref = sk_ref = None
     ik = pl.program_id(2)  # kv-major: kv block is the outer loop dim
     t = pl.program_id(3)  # sweeps (q_block, group member): iq = t // group
     iq = t // group
@@ -233,21 +322,28 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
         o = o_ref[0, 0].astype(jnp.float32)
+        # q and do feed the dk/dv contractions over q rows: zero their
+        # ragged tails (a zeroed p alone cannot kill 0·NaN products)
+        q = _zero_oob_rows(q, iq * block_q, seq_q, block_q)
+        do = _zero_oob_rows(do, iq * block_q, seq_q, block_q)
         lse = lse_ref[0, 0][:, :1]
         delta = jnp.sum(do * o, axis=-1, keepdims=True)  # (bq, 1)
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
-        if causal:
-            qpos = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 0
-            )
-            kpos = ik * block_kv + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 1
-            )
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        # q-bound masking matters HERE: out-of-range q rows would otherwise
+        # accumulate into dk/dv through garbage lse/delta reads. p and ds
+        # are zeroed through `where` (not via s=-inf alone) because
+        # exp(-inf - garbage_lse) is not reliably zero.
+        mask = _score_mask(
+            iq, ik, block_q=block_q, block_kv=block_kv, causal=causal,
+            seq_q=seq_q, seq_kv=seq_kv, sq_ref=sq_ref, sk_ref=sk_ref,
+            mask_q_bound=True,
+        )
         p = jnp.exp(s - lse)  # (bq, bk)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
         dv_scr[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -255,6 +351,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta) * scale
+        if mask is not None:
+            ds = jnp.where(mask, ds, 0.0)
         dk_scr[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -266,7 +364,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
 
 
 def _bwd(causal, scale, block_q, block_kv, res, g):
-    q, k, v, out, lse = res
+    q, k, v, seg, out, lse = res
     do, _ = g  # gradient wrt (out, lse); lse grad unused
     b, s, hq, d = q.shape
     _, sk, hkv, _ = k.shape
@@ -275,6 +373,7 @@ def _bwd(causal, scale, block_q, block_kv, res, g):
     bk = min(block_kv, sk)
     nq = pl.cdiv(s, bq)
     nk = pl.cdiv(sk, bk)
+    has_segments = seg is not None
 
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
@@ -284,22 +383,31 @@ def _bwd(causal, scale, block_q, block_kv, res, g):
 
     dq_kernel = functools.partial(
         _bwd_dq_kernel, scale=scale, block_q=bq, block_kv=bk,
-        causal=causal, num_kv_blocks=nk,
+        causal=causal, num_kv_blocks=nk, seq_q=s, seq_kv=sk,
+        has_segments=has_segments,
     )
+    dq_in_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda bi, hi, qi, ki, g=group: (bi, hi // g, ki, 0)),
+        pl.BlockSpec((1, 1, bk, d),
+                     lambda bi, hi, qi, ki, g=group: (bi, hi // g, ki, 0)),
+        pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, bq, LSE_LANES),
+                     lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+    ]
+    dq_inputs = [qt, kt, vt, dot, outt, lse]
+    if has_segments:
+        dq_in_specs += [
+            pl.BlockSpec((1, bq), lambda bi, hi, qi, ki: (bi, qi)),
+            pl.BlockSpec((1, bk), lambda bi, hi, qi, ki: (bi, ki)),
+        ]
+        dq_inputs += [seg, seg]
     dq = pl.pallas_call(
         dq_kernel,
         grid=(b, hq, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda bi, hi, qi, ki, g=group: (bi, hi // g, ki, 0)),
-            pl.BlockSpec((1, 1, bk, d),
-                         lambda bi, hi, qi, ki, g=group: (bi, hi // g, ki, 0)),
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, LSE_LANES),
-                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b, hq, s, d), q.dtype),
         scratch_shapes=[
@@ -307,7 +415,7 @@ def _bwd(causal, scale, block_q, block_kv, res, g):
             pltpu.VMEM((bq, LANES), jnp.float32),
         ],
         interpret=_interpret(),
-    )(qt, kt, vt, dot, outt, lse)
+    )(*dq_inputs)
 
     # dk/dv: grid dim 3 sweeps (q_block × GQA group member) so the whole
     # group's contribution accumulates in VMEM scratch and each output
@@ -315,25 +423,34 @@ def _bwd(causal, scale, block_q, block_kv, res, g):
     # (b, q_heads, s, d) f32 intermediates (2×2.1G at the 1B bench point)
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, scale=scale, block_q=bq, block_kv=bk,
-        causal=causal, num_q_blocks=nq, group=group,
+        causal=causal, num_q_blocks=nq, group=group, seq_q=s, seq_kv=sk,
+        has_segments=has_segments,
     )
     qhead = lambda hi, t, g=group: hi * g + t % g  # noqa: E731
     qblock = lambda t, g=group: t // g  # noqa: E731
+    dkv_in_specs = [
+        pl.BlockSpec((1, 1, bq, d),
+                     lambda bi, hi, ki, t: (bi, qhead(hi, t), qblock(t), 0)),
+        pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, t: (bi, hi, ki, 0)),
+        pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, t: (bi, hi, ki, 0)),
+        pl.BlockSpec((1, 1, bq, d),
+                     lambda bi, hi, ki, t: (bi, qhead(hi, t), qblock(t), 0)),
+        pl.BlockSpec((1, 1, bq, d),
+                     lambda bi, hi, ki, t: (bi, qhead(hi, t), qblock(t), 0)),
+        pl.BlockSpec((1, 1, bq, LSE_LANES),
+                     lambda bi, hi, ki, t: (bi, qhead(hi, t), qblock(t), 0)),
+    ]
+    dkv_inputs = [qt, kt, vt, dot, outt, lse]
+    if has_segments:
+        dkv_in_specs += [
+            pl.BlockSpec((1, bq), lambda bi, hi, ki, t: (bi, qblock(t))),
+            pl.BlockSpec((1, bk), lambda bi, hi, ki, t: (bi, ki)),
+        ]
+        dkv_inputs += [seg, seg]
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(b, hkv, nk, nq * group),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d),
-                         lambda bi, hi, ki, t: (bi, qhead(hi, t), qblock(t), 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, t: (bi, hi, ki, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, t: (bi, hi, ki, 0)),
-            pl.BlockSpec((1, 1, bq, d),
-                         lambda bi, hi, ki, t: (bi, qhead(hi, t), qblock(t), 0)),
-            pl.BlockSpec((1, 1, bq, d),
-                         lambda bi, hi, ki, t: (bi, qhead(hi, t), qblock(t), 0)),
-            pl.BlockSpec((1, 1, bq, LSE_LANES),
-                         lambda bi, hi, ki, t: (bi, qhead(hi, t), qblock(t), 0)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, t: (bi, hi, ki, 0)),
             pl.BlockSpec((1, 1, bk, d), lambda bi, hi, ki, t: (bi, hi, ki, 0)),
@@ -347,7 +464,7 @@ def _bwd(causal, scale, block_q, block_kv, res, g):
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(qt, kt, vt, dot, outt, lse)
+    )(*dkv_inputs)
 
     return (
         dq.transpose(0, 2, 1, 3),
@@ -359,39 +476,53 @@ def _bwd(causal, scale, block_q, block_kv, res, g):
 # =========================== public API ====================================
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, scale, block_q, block_kv):
-    out, _ = _fwd(q, k, v, causal=causal, scale=scale,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, seg, causal, scale, block_q, block_kv):
+    out, _ = _fwd(q, k, v, seg, causal=causal, scale=scale,
                   block_q=block_q, block_kv=block_kv)
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_kv):
-    out, lse = _fwd(q, k, v, causal=causal, scale=scale,
+def _flash_fwd(q, k, v, seg, causal, scale, block_q, block_kv):
+    out, lse = _fwd(q, k, v, seg, causal=causal, scale=scale,
                     block_q=block_q, block_kv=block_kv)
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, seg, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_kv, res, g):
-    return _bwd(causal, scale, block_q, block_kv, (*res[:4], res[4]), (g, None))
+    dq, dk, dv = _bwd(causal, scale, block_q, block_kv, res, (g, None))
+    seg = res[3]
+    # segment ids are integral: their cotangent type is float0
+    dseg = (
+        None if seg is None else np.zeros(seg.shape, jax.dtypes.float0)
+    )
+    return dq, dk, dv, dseg
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, *, causal=True, scale=None,
-                    block_q=512, block_kv=512):
+                    block_q=512, block_kv=512, segment_ids=None):
     """Drop-in replacement for ``sdpa_attention`` (same signature/shapes),
-    backed by the Pallas kernels. Falls back to the XLA path when shapes
-    don't block cleanly (tiny test configs)."""
+    backed by the Pallas kernels. Total over sequence lengths and head
+    dims (masked tail blocks / lane padding); ``segment_ids`` (batch,
+    seq) restricts attention to within-segment for packed sequences.
+    There is NO silent fallback: every valid GQA config runs in the
+    kernel, and a malformed one (q heads not a multiple of kv heads)
+    raises exactly like ``sdpa_attention`` does."""
     b, s, hq, d = q.shape
     _, sk, hkv, _ = k.shape
     if scale is None:
         scale = 1.0 / (d**0.5)
+    if hq % hkv:
+        # same contract as sdpa_attention — there is no path that can run
+        # a non-multiple GQA config, so fail loudly rather than degrade
+        raise ValueError(f"n_heads={hq} not divisible by n_kv_heads={hkv}")
+    if segment_ids is not None:
+        if s != sk:
+            raise ValueError("segment_ids requires q_len == kv_len")
+        segment_ids = segment_ids.astype(jnp.int32)
     bq = min(block_q, s)
     bk = min(block_kv, sk)
-    if s % bq or sk % bk or hq % hkv or d % 128:
-        from pyrecover_tpu.ops.attention import sdpa_attention
-
-        return sdpa_attention(q, k, v, causal=causal, scale=scale)
-    return _flash(q, k, v, causal, scale, bq, bk)
+    return _flash(q, k, v, segment_ids, causal, scale, bq, bk)

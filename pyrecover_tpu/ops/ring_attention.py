@@ -66,12 +66,27 @@ def _block_update(qg, k, v, q_start, k_start, scale, causal, m, l, acc):
 
 
 def _split_blocks(x, block):
-    """(B, S, ...) → (nb, B, block, ...) when S divides evenly, else 1 block."""
+    """(B, S, ...) → (nb, B, block, ...) when S divides evenly, else 1 block.
+
+    The single-block fallback materializes the full (Sq × Sk_chunk) score
+    matrix — exactly what the blockwise form exists to avoid — so a
+    non-divisible per-device chunk warns loudly (trace-time, once per
+    compile) instead of silently losing the memory bound."""
     s = x.shape[1]
     if block and s % block == 0 and s > block:
         nb = s // block
         return jnp.moveaxis(
             x.reshape(x.shape[0], nb, block, *x.shape[2:]), 1, 0
+        )
+    if block and s > block:
+        from pyrecover_tpu.utils.logging import log_host0
+
+        log_host0(
+            "ring attention: per-device KV chunk %d not divisible by "
+            "block_kv %d; falling back to ONE full-size block — the "
+            "(Sq x Sk_chunk) score matrix is materialized. Pick a "
+            "block_kv dividing seq_len/ring_size to keep the memory bound.",
+            s, block,
         )
     return x[None]
 

@@ -236,9 +236,11 @@ def pipeline_blocks(layer_params, x, block_fn, n_microbatches=0):
         out0 = tmap(lambda m: _pvary(jnp.zeros_like(m)), mbs)
         (_, out), _ = jax.lax.scan(tick, (carry0, out0), jnp.arange(T))
         # results live on the last stage only; replicate them back over the
-        # pipeline axis (masked psum — everyone else contributes zeros)
+        # pipeline axis (masked psum — everyone else contributes zeros;
+        # zeros_like keeps integer carry leaves, e.g. segment ids, integral)
         return jax.lax.psum(
-            tmap(lambda o: jnp.where(s == S - 1, o, 0.0), out), AXIS_PIPE
+            tmap(lambda o: jnp.where(s == S - 1, o, jnp.zeros_like(o)), out),
+            AXIS_PIPE,
         )
 
     mbs = tmap(lambda l: to_io(l.reshape(M, b // M, *l.shape[1:])), x)

@@ -8,10 +8,12 @@ Re-designed for TPU:
 
   * Deadline sources, in priority order: an explicit ``--job-end-time``,
     the ``JOB_END_TIME`` / ``SLURM_JOB_END_TIME`` env vars (reference
-    dist_utils.py:93-101), and — TPU-native — a *preemption notice file*
-    whose appearance means "save now" (Cloud TPU maintenance events /
-    queued-resource eviction and SIGTERM both funnel into it; see
-    ``install_signal_handler``).
+    dist_utils.py:93-101), and — TPU-native — a *preemption notice* that
+    means "save now". Three producers feed it: SIGTERM/SIGUSR1
+    (``install_signal_handler``), an externally-touched notice file
+    (``$PYRECOVER_PREEMPT_FILE``), and the Cloud TPU maintenance-event
+    watcher (``maintenance.py``) long-polling the GCE metadata server for
+    TERMINATE/preemption announcements that never arrive as signals.
   * Adaptive safety buffer: thresholds start from ``--default-iter-time`` /
     ``--default-ckpt-time`` and track observed maxima (reference
     train.py:298-307, 334-337). The reference's two inconsistent buffer
@@ -32,6 +34,8 @@ import os
 import signal
 import time
 from pathlib import Path
+
+import jax
 
 from pyrecover_tpu.parallel.mesh import broadcast_host0_scalar
 from pyrecover_tpu.utils.logging import log_host0
@@ -72,6 +76,8 @@ class PreemptionWatcher:
         notice = notice_file or os.environ.get(PREEMPT_NOTICE_ENV)
         self.notice_file = Path(notice) if notice else None
         self._signal_seen = False
+        self._notice_logged = False
+        self._maintenance_watcher = None
         if self.enabled:
             if self.job_end_time is not None:
                 log_host0(
@@ -113,6 +119,38 @@ class PreemptionWatcher:
             pass
         return self
 
+    def start_maintenance_watcher(self):
+        """Start the Cloud TPU maintenance-event producer (maintenance.py):
+        a host-0 daemon thread long-polling the GCE metadata server and
+        funneling TERMINATE/preemption announcements into this watcher —
+        the notice the file/SIGTERM hooks were built to consume. Started
+        when time-aware checkpointing is enabled on a TPU platform, or
+        whenever ``$PYRECOVER_METADATA_BASE`` names a metadata server (the
+        test hook). No-op elsewhere: off GCE the thread retires itself
+        after its first few failed metadata requests."""
+        if not self.enabled or self._maintenance_watcher is not None:
+            return self
+        if jax.process_index() != 0:
+            return self
+        from pyrecover_tpu.maintenance import METADATA_BASE_ENV
+
+        on_tpu = jax.devices()[0].platform == "tpu"
+        if not on_tpu and not os.environ.get(METADATA_BASE_ENV):
+            return self
+        from pyrecover_tpu.maintenance import MaintenanceEventWatcher
+
+        def _on_event(_description):
+            self._signal_seen = True
+
+        self._maintenance_watcher = MaintenanceEventWatcher(
+            on_event=_on_event, notice_file=self.notice_file
+        ).start()
+        return self
+
+    def stop_maintenance_watcher(self):
+        if self._maintenance_watcher is not None:
+            self._maintenance_watcher.stop()
+
     def _notice_present(self):
         if self._signal_seen:
             return True
@@ -126,15 +164,33 @@ class PreemptionWatcher:
         return self.enabled and step % self.check_interval == 0
 
     def should_stop(self, step=None):
-        """Called once per step (pass the global step). Runs the real check —
-        device-visible deadline math + a cross-host broadcast — only every
-        ``check_interval``-th step; other steps return False with zero
-        device/host traffic. Returns True on every host when it is time to
-        take the final checkpoint and exit."""
+        """Called once per step (pass the global step). The cheap host-local
+        signals — a delivered SIGTERM/SIGUSR1, the notice file's existence —
+        are checked EVERY step (a flag read + one stat syscall); the
+        interval gating applies only to what actually costs something: the
+        deadline decision's cross-host broadcast. Single-process, a notice
+        therefore stops on the very step it lands (the broadcast is an
+        identity). Multi-host, an off-schedule notice is logged immediately
+        but the coordinated decision waits for the next check step — every
+        host must issue the broadcast collective on the same step, and the
+        preemption grace window is sized for that ≤(k-1)-step delay by the
+        check-interval-aware threshold below. Returns True on every host
+        when it is time to take the final checkpoint and exit."""
         if not self.enabled:
             return False
         if step is not None and not self.is_check_step(step):
-            return False
+            if not self._notice_present():
+                return False
+            if jax.process_count() > 1:
+                if not self._notice_logged:
+                    self._notice_logged = True
+                    log_host0(
+                        "Preemption notice observed mid-interval; "
+                        "coordinating the stop at the next check step "
+                        "(<= %d steps away)", self.check_interval - 1,
+                    )
+                return False
+            # single-process: no collective to coordinate — stop now
         decision = False
         reason = None
         if self._notice_present():

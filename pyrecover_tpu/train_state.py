@@ -132,12 +132,14 @@ def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0,
             f"grad_accumulation_steps must be >= 1, got {grad_accumulation_steps}"
         )
 
-    def micro_loss(params, inputs, labels, n_total, rows_total):
+    def micro_loss(params, inputs, labels, segments, n_total, rows_total):
         """Micro-batch objective: ``Σ_chunk CE / N_total`` (+ row-weighted
         aux). Its grads SUM over micro-steps to the full-batch grads."""
         from pyrecover_tpu.models.llama import forward_hidden_with_aux
 
-        hidden, moe_aux = forward_hidden_with_aux(params, inputs, model_config)
+        hidden, moe_aux = forward_hidden_with_aux(
+            params, inputs, model_config, segment_ids=segments
+        )
         ce, n = chunked_ce(params, hidden, labels, model_config, loss_chunk_size)
         total = ce * jnp.maximum(n, 1).astype(jnp.float32) / n_total
         if model_config.n_experts > 0:
@@ -149,12 +151,14 @@ def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0,
         return total, moe_aux
 
     def step_fn(state, batch):
+        segments = batch.get("segments")  # packed-sequence ids or None
         if A == 1:
             def loss_fn(params):
                 from pyrecover_tpu.models.llama import forward_hidden_with_aux
 
                 hidden, moe_aux = forward_hidden_with_aux(
-                    params, batch["inputs"], model_config
+                    params, batch["inputs"], model_config,
+                    segment_ids=segments,
                 )
                 ce, n_valid = chunked_ce(
                     params, hidden, batch["labels"], model_config,
@@ -176,15 +180,19 @@ def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0,
                 )
             inputs = batch["inputs"].reshape(A, B // A, -1)
             labels = batch["labels"].reshape(A, B // A, -1)
+            segs = (
+                None if segments is None
+                else segments.reshape(A, B // A, -1)
+            )
             n_total = jnp.maximum(
                 jnp.sum(labels != IGNORE_INDEX), 1
             ).astype(jnp.float32)
 
             def micro(acc, xs):
-                inp, lab = xs
+                inp, lab, sg = xs if segs is not None else (*xs, None)
                 (obj, moe_aux), g = jax.value_and_grad(
                     micro_loss, has_aux=True
-                )(state.params, inp, lab, n_total, float(B))
+                )(state.params, inp, lab, sg, n_total, float(B))
                 acc_g, acc_obj, acc_aux = acc
                 acc_g = jax.tree_util.tree_map(
                     lambda a, b: a + b.astype(jnp.float32), acc_g, g
@@ -195,9 +203,11 @@ def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0,
             zero_g = jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), state.params
             )
+            xs = (
+                (inputs, labels) if segs is None else (inputs, labels, segs)
+            )
             (grads, obj, moe_aux), _ = jax.lax.scan(
-                micro, (zero_g, jnp.float32(0), jnp.float32(0)),
-                (inputs, labels),
+                micro, (zero_g, jnp.float32(0), jnp.float32(0)), xs,
             )
             grads = jax.tree_util.tree_map(
                 lambda g, p: g.astype(p.dtype), grads, state.params
@@ -256,7 +266,10 @@ def make_eval_step(model_config, loss_chunk_size=0):
 
     @partial(jax.jit)
     def fn(params, batch):
-        hidden = forward_hidden(params, batch["inputs"], model_config)
+        hidden = forward_hidden(
+            params, batch["inputs"], model_config,
+            segment_ids=batch.get("segments"),
+        )
         ce, n_valid = chunked_ce(
             params, hidden, batch["labels"], model_config, loss_chunk_size
         )

@@ -7,6 +7,7 @@ checkpointing from a multi-process mesh."""
 import json
 import sys
 
+import pyrecover_tpu  # noqa: F401  (re-asserts JAX_PLATFORMS before jax init)
 import jax
 
 import os

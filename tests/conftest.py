@@ -17,6 +17,13 @@ import os
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()
+# The tests are CPU-only; make sure SUBPROCESSES they spawn (launcher,
+# multiprocess rendezvous, tools) inherit an environment that neither
+# registers an accelerator PJRT plugin at interpreter start (a flaky
+# tunnel makes that registration hang every python process) nor resolves
+# to a non-CPU platform.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 

@@ -1,0 +1,218 @@
+"""Maintenance-event watcher tests: a fake local GCE metadata server
+long-polled by the daemon thread, firing the notice with no SIGTERM —
+the TPU-native re-sourcing of the reference's deadline poll
+(reference train.py:223-232; SURVEY §5 failure-detection row)."""
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+from pyrecover_tpu.maintenance import (
+    DEFAULT_METADATA_BASE,
+    METADATA_BASE_ENV,
+    MaintenanceEventWatcher,
+    metadata_base,
+)
+
+
+class FakeMetadataServer:
+    """Minimal GCE metadata server: serves ``instance/preempted`` and a
+    long-pollable ``instance/maintenance-event`` with etag semantics."""
+
+    def __init__(self):
+        self.maintenance_value = "NONE"
+        self.preempted = "FALSE"
+        self.etag = "aaaa"
+        self._changed = threading.Event()
+        self.requests_seen = []
+
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                q = parse_qs(parsed.query)
+                fake.requests_seen.append(parsed.path)
+                if self.headers.get("Metadata-Flavor") != "Google":
+                    self.send_error(403, "Missing Metadata-Flavor header")
+                    return
+                if parsed.path.endswith("/instance/preempted"):
+                    self._reply(fake.preempted)
+                elif parsed.path.endswith("/instance/maintenance-event"):
+                    if q.get("wait_for_change", ["false"])[0] == "true" and (
+                        q.get("last_etag", [""])[0] == fake.etag
+                    ):
+                        # hold until the value changes or the poll times out
+                        fake._changed.wait(
+                            timeout=float(q.get("timeout_sec", ["1"])[0])
+                        )
+                    self._reply(fake.maintenance_value)
+                else:
+                    self.send_error(404)
+
+            def _reply(self, body):
+                data = body.encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.send_header("ETag", fake.etag)
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    @property
+    def base(self):
+        host, port = self._server.server_address
+        return f"http://{host}:{port}/computeMetadata/v1"
+
+    def announce_maintenance(self, value="TERMINATE_ON_HOST_MAINTENANCE"):
+        self.maintenance_value = value
+        self.etag = "bbbb"
+        self._changed.set()
+
+    def announce_preemption(self):
+        self.preempted = "TRUE"
+
+    def shutdown(self):
+        self._server.shutdown()
+
+
+@pytest.fixture
+def fake_metadata():
+    server = FakeMetadataServer().start()
+    yield server
+    server.shutdown()
+
+
+def _wait_for(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_metadata_base_env_override(monkeypatch):
+    assert metadata_base() == DEFAULT_METADATA_BASE
+    monkeypatch.setenv(METADATA_BASE_ENV, "http://127.0.0.1:1/v1")
+    assert metadata_base() == "http://127.0.0.1:1/v1"
+
+
+def test_terminate_event_fires_callback_and_notice_file(fake_metadata, tmp_path):
+    notice = tmp_path / "notices" / "preempt"
+    fired = []
+    w = MaintenanceEventWatcher(
+        on_event=fired.append, notice_file=notice, base=fake_metadata.base,
+        poll_timeout_s=5,
+    ).start()
+    # steady state: long-poll hanging, nothing fired
+    assert _wait_for(lambda: fake_metadata.requests_seen)
+    time.sleep(0.2)
+    assert not fired and not notice.exists()
+
+    fake_metadata.announce_maintenance()
+    assert _wait_for(lambda: fired)
+    assert fired == ["instance/maintenance-event=TERMINATE_ON_HOST_MAINTENANCE"]
+    assert notice.read_text() == fired[0]
+    assert _wait_for(lambda: not w.alive)  # one-shot: thread retires
+
+
+def test_preempted_flag_fires(fake_metadata):
+    fired = []
+    w = MaintenanceEventWatcher(
+        on_event=fired.append, base=fake_metadata.base, poll_timeout_s=1
+    )
+    fake_metadata.announce_preemption()
+    w.start()
+    assert _wait_for(lambda: fired)
+    assert fired == ["instance/preempted=TRUE"]
+
+
+def test_migrate_event_is_actionable(fake_metadata):
+    """TPU VMs can't live-migrate: MIGRATE_ON_HOST_MAINTENANCE must also
+    trigger the final-checkpoint path."""
+    fired = []
+    MaintenanceEventWatcher(
+        on_event=fired.append, base=fake_metadata.base, poll_timeout_s=5
+    ).start()
+    fake_metadata.announce_maintenance("MIGRATE_ON_HOST_MAINTENANCE")
+    assert _wait_for(lambda: fired)
+
+
+def test_watcher_retires_off_gce():
+    """No metadata server (not on GCE): the thread gives up quietly after a
+    few failed requests instead of spinning forever."""
+    w = MaintenanceEventWatcher(
+        base="http://127.0.0.1:1/computeMetadata/v1",  # nothing listens
+        poll_timeout_s=1, max_consecutive_errors=2,
+    ).start()
+    assert _wait_for(lambda: not w.alive, timeout=30)
+    assert w.event_seen is None
+
+
+def test_preemption_watcher_wiring(fake_metadata, tmp_path, monkeypatch):
+    """start_maintenance_watcher funnels a metadata event into
+    PreemptionWatcher._signal_seen (and should_stop) with no SIGTERM."""
+    from pyrecover_tpu.preempt import PreemptionWatcher
+
+    monkeypatch.setenv(METADATA_BASE_ENV, fake_metadata.base)
+    w = PreemptionWatcher(
+        enabled=True, job_end_time=None, check_interval=50
+    ).start_maintenance_watcher()
+    assert w._maintenance_watcher is not None
+    assert not w.should_stop(1)
+    fake_metadata.announce_maintenance()
+    assert _wait_for(lambda: w._signal_seen)
+    assert w.should_stop(2)  # mid-interval: host-local signal, no broadcast
+    w.stop_maintenance_watcher()
+
+
+@pytest.mark.slow
+def test_training_run_preempted_via_metadata_server(fake_metadata, tmp_path,
+                                                    monkeypatch):
+    """The round-4 'done' criterion: a real training run is preempted by
+    the fake metadata server alone — no SIGTERM, no notice file written by
+    the test — and exits with a _final checkpoint + REQUEUE marker."""
+    from pyrecover_tpu.config import TrainConfig
+    from pyrecover_tpu.models import ModelConfig
+    from pyrecover_tpu.preempt import DONE_MARKER, REQUEUE_MARKER
+    from pyrecover_tpu.train import train
+
+    monkeypatch.setenv(METADATA_BASE_ENV, fake_metadata.base)
+    cfg = TrainConfig(
+        sequence_length=32, batch_size=8, training_samples=64,
+        training_steps=100000, learning_rate=1e-3, lr_warmup_steps=2,
+        seed=13, checkpoint_dir=str(tmp_path), checkpoint_frequency=100000,
+        experiment_name="mt", logging_frequency=100000,
+        timeaware_checkpointing=True, preempt_check_interval=7,
+        async_checkpoint=False,
+    )
+    cfg.model = ModelConfig().tiny(max_seq_len=32, vocab_size=128)
+    cfg.__post_init__()
+
+    # announce maintenance shortly after training starts
+    announcer = threading.Timer(1.5, fake_metadata.announce_maintenance)
+    announcer.start()
+    try:
+        _, end_step, stopped = train(cfg)
+    finally:
+        announcer.cancel()
+    assert stopped and end_step < 100000
+    exp = tmp_path / "mt"
+    assert len(list(exp.glob("ckpt_*_final.ckpt"))) == 1
+    assert (exp / REQUEUE_MARKER).exists()
+    assert not (exp / DONE_MARKER).exists()

@@ -63,6 +63,18 @@ def test_loss_csv_logger(tmp_path):
     assert len(rows) == 3
 
 
+def test_loss_csv_resume_drops_torn_rows(tmp_path):
+    """A kill mid-write can tear the CSV's final row; resume must drop the
+    unparseable row(s) and keep going, not abort training startup."""
+    path = tmp_path / "exp_loss_log.csv"
+    path.write_text("step,loss\n1,2.5\n2,2.25\n3,2.1\nbad-row\n4")
+    logger = LossCSVLogger(tmp_path, "exp", enabled=True, resume_step=2)
+    logger.log(3, 2.0)
+    logger.close()
+    rows = list(csv.reader(open(path)))
+    assert rows == [["step", "loss"], ["1", "2.5"], ["2", "2.25"], ["3", "2.0"]]
+
+
 def test_walltime_totals_summary():
     t = WallTimeTotals()
     t.train_s, t.ckpt_save_s, t.ckpt_load_s = 10.0, 1.5, 0.5

@@ -164,10 +164,61 @@ def test_scatter_dispatch_matches_masked_einsum_reference():
     w2 = jax.random.normal(ks[4], (E, F, cfg.dim)) * 0.02
 
     y_ref = jax.jit(reference_moe)(h, router, w1, w3, w2)
-    for backend in (_moe_ffn_impl, _moe_ffn_einsum):
+    from pyrecover_tpu.models.moe import _moe_ffn_grouped
+
+    for backend in (_moe_ffn_impl, _moe_ffn_einsum, _moe_ffn_grouped):
         y, _ = jax.jit(lambda *a: backend(*a, cfg))(h, router, w1, w3, w2)
         np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_grouped_dispatch_gradients_match_scatter():
+    """The ragged-GEMM backend must agree with the scatter backend under
+    autodiff too — same loss, same input and weight gradients."""
+    from pyrecover_tpu.models.moe import _moe_ffn_grouped, _moe_ffn_impl
+
+    cfg = dataclasses.replace(MOE_CFG, moe_capacity_factor=0.6)  # force drops
+    E, F = cfg.n_experts, cfg.expert_hidden_dim
+    ks = jax.random.split(jax.random.key(3), 5)
+    h = jax.random.normal(ks[0], (2, 32, cfg.dim), dtype=jnp.float32)
+    router = jax.random.normal(ks[1], (cfg.dim, E), jnp.float32) * 0.5
+    w1 = jax.random.normal(ks[2], (E, cfg.dim, F)) * 0.02
+    w3 = jax.random.normal(ks[3], (E, cfg.dim, F)) * 0.02
+    w2 = jax.random.normal(ks[4], (E, F, cfg.dim)) * 0.02
+
+    def make_loss(backend):
+        def loss(h, router, w1, w3, w2):
+            y, aux = backend(h, router, w1, w3, w2, cfg)
+            return jnp.sum(y**2) + jnp.mean(aux)
+
+        return jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2, 3, 4)))
+
+    ref_l, ref_g = make_loss(_moe_ffn_impl)(h, router, w1, w3, w2)
+    l, g = make_loss(_moe_ffn_grouped)(h, router, w1, w3, w2)
+    np.testing.assert_allclose(float(l), float(ref_l), rtol=1e-5)
+    for a, b in zip(g, ref_g):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_grouped_dispatch_dp_fsdp_matches_single_device(single_device_run,
+                                                        devices8):
+    """moe_dispatch='grouped' (the auto pick when ep == 1) under dp×fsdp
+    sharding: the per-row sort/gather must be transparent to batch
+    sharding — same losses and weights as the single-device run."""
+    ref_state, ref_losses = single_device_run
+    cfg = dataclasses.replace(MOE_CFG, moe_dispatch="grouped")
+    state, losses = run_steps(MeshConfig(data=4, fsdp=2), cfg)
+    np.testing.assert_allclose(losses, ref_losses, rtol=5e-4, atol=5e-4)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(ref_state.params),
+        jax.tree_util.tree_leaves(state.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a, dtype=np.float32), np.asarray(b, dtype=np.float32),
+            rtol=2e-3, atol=2e-3,
+        )
 
 
 def test_analytic_param_count_matches_init():

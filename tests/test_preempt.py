@@ -92,6 +92,22 @@ def test_check_interval_gates_the_decision():
     assert w.should_stop()
 
 
+def test_notice_checked_every_step_despite_interval(tmp_path):
+    """Cheap host-local signals (notice file / SIGTERM) are observed on
+    EVERY step; only the deadline decision is gated to check steps.
+    Single-process there is no broadcast to coordinate, so the notice
+    stops on the very step it lands — the grace window never shrinks by
+    up to k-1 iterations (advisor finding r3)."""
+    notice = tmp_path / "preempt-notice"
+    w = PreemptionWatcher(
+        enabled=True, job_end_time=None, notice_file=notice, check_interval=50
+    )
+    assert not w.should_stop(1)
+    notice.write_text("maintenance event")
+    assert not w.is_check_step(2)
+    assert w.should_stop(2)  # mid-interval step — still stops
+
+
 def test_check_interval_widens_threshold():
     # deadline in 40s; per-step check (interval 1): iter+ckpt+buffer =
     # 1+10+(5+20)=36 < 40 → keep going; interval 20: 20+10+25=55 > 40 → stop

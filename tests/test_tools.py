@@ -126,15 +126,20 @@ def test_generate_from_checkpoint(tmp_path):
         "--multiple-of", "32", "--prompt-ids", "1,2,3",
         "--max-new-tokens", "5",
     ]
-    out1 = subprocess.run(args, capture_output=True, text=True, timeout=300)
+    import os
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}  # no accelerator in tests
+    out1 = subprocess.run(args, capture_output=True, text=True, timeout=300,
+                          env=env)
     assert out1.returncode == 0, out1.stderr[-2000:]
     ids = [int(x) for x in out1.stdout.strip().split(",")]
     assert len(ids) == 8 and ids[:3] == [1, 2, 3]
     assert all(0 <= i < 128 for i in ids)
     # greedy is deterministic
-    out2 = subprocess.run(args, capture_output=True, text=True, timeout=300)
+    out2 = subprocess.run(args, capture_output=True, text=True, timeout=300,
+                          env=env)
     assert out2.stdout == out1.stdout
     # temperature sampling runs
     out3 = subprocess.run(args + ["--temperature", "1.0"], capture_output=True,
-                          text=True, timeout=300)
+                          text=True, timeout=300, env=env)
     assert out3.returncode == 0, out3.stderr[-2000:]

@@ -99,6 +99,21 @@ def test_loss_csv_spans_interrupt_resume(tmp_path):
     assert [r[0] for r in rows] == ["step", "1", "2"]
 
 
+def test_loss_csv_batched_flush_matches_per_step(tmp_path):
+    """--log-loss-to-csv no longer syncs every step: losses buffer as
+    device scalars and flush at sync points (logging steps / end of run).
+    The CSV must still contain every step exactly once, in order."""
+    import csv as csvlib
+
+    cfg = tiny_config(
+        tmp_path, training_steps=7, log_loss_to_csv=True, logging_frequency=3
+    )
+    train(cfg)
+    rows = list(csvlib.reader(open(tmp_path / "e2e" / "e2e_loss_log.csv")))
+    assert [r[0] for r in rows] == ["step"] + [str(i) for i in range(1, 8)]
+    assert all(float(r[1]) > 0 for r in rows[1:])
+
+
 def test_timeaware_stop_and_requeue(tmp_path):
     """Deadline already inside the safety buffer → stop after one step,
     write a _final checkpoint and the REQUEUE marker."""
