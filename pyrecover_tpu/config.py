@@ -59,6 +59,10 @@ class TrainConfig:
     attention_impl: str = "auto"  # auto | sdpa | flash | ring
     remat: bool = False
     pp_microbatches: int = 0  # pipeline microbatches; 0 → stage count
+    # "gpipe": AD-derived backward wave (composes with everything);
+    # "1f1b": explicit interleaved backward — bounds in-flight microbatch
+    # activations per stage to the stage count (parallel/pipeline.py)
+    pp_schedule: str = "gpipe"
     loss_chunk_size: int = 0  # >0: fused chunked CE, never materializes full logits
     # -- parallelism ---------------------------------------------------------
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
@@ -121,6 +125,7 @@ class TrainConfig:
             attention_impl=attn,
             remat=self.remat or self.model.remat,
             pp_microbatches=self.pp_microbatches or self.model.pp_microbatches,
+            pp_schedule=self.pp_schedule,
         )
 
 
@@ -216,6 +221,11 @@ def build_parser():
                    help="pipeline-parallel stages (layers sharded across stages)")
     p.add_argument("--pp-microbatches", type=int, default=d.pp_microbatches,
                    help="pipeline microbatch count; 0 = number of stages")
+    p.add_argument("--pp-schedule", type=str, default=d.pp_schedule,
+                   choices=["gpipe", "1f1b"],
+                   help="pipeline training schedule: gpipe (AD backward "
+                        "wave) or 1f1b (interleaved backward; in-flight "
+                        "activations bounded to the stage count)")
     p.add_argument("--ep", type=int, default=d.mesh.expert,
                    help="expert-parallel axis size (MoE experts sharded)")
 
@@ -303,6 +313,7 @@ def get_args(argv=None):
         mesh=MeshConfig(data=ns.dp, fsdp=ns.fsdp, tensor=ns.tp, sequence=ns.sp,
                         pipeline=ns.pp, expert=ns.ep),
         pp_microbatches=ns.pp_microbatches,
+        pp_schedule=ns.pp_schedule,
         distributed=ns.distributed,
         checkpoint_dir=ns.checkpoint_dir,
         checkpoint_frequency=ns.checkpoint_frequency,

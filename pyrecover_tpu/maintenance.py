@@ -147,6 +147,7 @@ class MaintenanceEventWatcher:
                 # preempted is a plain read (no etag churn): spot/queued-
                 # resource reclaims flip it without a maintenance-event
                 val, _ = self._get("instance/preempted", timeout=10)
+                errors = 0  # any successful request proves the server lives
                 if val.upper() == "TRUE":
                     self._fire("instance/preempted=TRUE")
                     return

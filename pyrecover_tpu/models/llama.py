@@ -56,6 +56,10 @@ class ModelConfig:
     compute_dtype: str = "bfloat16"
     attention_impl: str = "sdpa"  # "sdpa" | "flash" | "ring"
     pp_microbatches: int = 0  # pipeline microbatch count; 0 → stage count
+    # pipeline training schedule: "gpipe" (AD-derived backward wave) or
+    # "1f1b" (explicit interleaved backward — in-flight microbatches per
+    # stage bounded to the stage count; parallel/pipeline.py)
+    pp_schedule: str = "gpipe"
     remat: bool = False
     # remat policy when remat=True: "full" recomputes everything
     # (nothing_saveable); "save-attn" keeps each block's attention output
@@ -83,6 +87,10 @@ class ModelConfig:
             raise ValueError(
                 f"remat_policy={self.remat_policy!r}: expected 'full' or "
                 "'save-attn'"
+            )
+        if self.pp_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"pp_schedule={self.pp_schedule!r}: expected 'gpipe' or '1f1b'"
             )
 
     @property

@@ -31,6 +31,7 @@ Mesh axes:
                    (SURVEY §2.2).
 """
 
+import contextlib
 import dataclasses
 import os
 
@@ -184,6 +185,30 @@ def nonmanual_axes(mesh):
     }
 
 
+_CONSTRAINTS_DISABLED = False
+
+
+@contextlib.contextmanager
+def constraints_disabled():
+    """Trace-time switch making ``constrain`` a no-op.
+
+    The 1F1B pipeline schedule (parallel/pipeline.py) runs model code
+    inside ``lax.cond`` branches whose predicate VARIES by pipeline stage.
+    A ``with_sharding_constraint`` there can make GSPMD insert reshard
+    collectives inside the branch — a collective only some stages execute,
+    which deadlocks the mesh (observed with the MoE dispatch constrains).
+    Inside that region the constraints are disabled and sharding
+    propagation from the (already-sharded) inputs carries the layouts.
+    """
+    global _CONSTRAINTS_DISABLED
+    prev = _CONSTRAINTS_DISABLED
+    _CONSTRAINTS_DISABLED = True
+    try:
+        yield
+    finally:
+        _CONSTRAINTS_DISABLED = prev
+
+
 def constrain(x, *spec):
     """``with_sharding_constraint`` that is a no-op outside a mesh context.
 
@@ -192,8 +217,11 @@ def constrain(x, *spec):
     constraint is applied, otherwise the value passes through untouched so
     the same model runs single-device. Axes that are missing from the mesh
     OR manually bound by an enclosing ``shard_map`` are dropped from the
-    spec, so the same model code also runs inside manual regions.
+    spec, so the same model code also runs inside manual regions (and
+    ``constraints_disabled`` regions skip the constraint entirely).
     """
+    if _CONSTRAINTS_DISABLED:
+        return x
     mesh = jax.sharding.get_abstract_mesh()
     if mesh is None or mesh.empty:
         return x

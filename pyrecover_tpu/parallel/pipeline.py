@@ -41,9 +41,15 @@ Measured honestly (virtual 8-device mesh, remat on, M=32/S=4, compiled
 not the 2× the buffer arithmetic suggests, because peak temp is dominated
 by the tick scan's AD residuals (one carried microbatch activation per
 tick, ≈ M+S-1 of them), which neither buffer layout touches. Block remat
-(``ModelConfig.remat``) is the lever that shrinks those; the queues bound
-the buffer term so it never becomes the limit as M grows.
+(``ModelConfig.remat``) is one lever that shrinks those; the REAL fix is
+the explicit 1F1B schedule below (``--pp-schedule 1f1b``), which bounds
+in-flight microbatches per stage to S by construction — measured at
+M=32/S=4 with remat OFF (tiny test model, same ``memory_analysis``):
+12.67 MB GPipe temp vs 1.07 MB 1F1B, an 11.8× reduction
+(tests/test_pipeline.py::test_1f1b_reduces_peak_memory_remat_off).
 """
+
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -273,3 +279,377 @@ def pipeline_blocks(layer_params, x, block_fn, n_microbatches=0):
             axis_names={AXIS_PIPE},
         )(layer_params, mbs)
     return from_io(tmap(lambda l: l.reshape(b, *l.shape[2:]), out))
+
+
+# ===================== 1F1B (one-forward-one-backward) ======================
+#
+# GPipe above derives its backward by AD: all M microbatches stream forward,
+# then AD replays the whole tick scan in reverse — so the scan's residuals
+# (≈ M+S-1 carried microbatch activations, plus each tick's full stack
+# residuals with remat off) are what bounds memory (module docstring).
+# 1F1B is the standard fix: interleave each microbatch's backward as soon
+# as its forward reaches the last stage, so a stage ever holds at most S
+# in-flight microbatches. AD cannot produce that order, so the schedule
+# below constructs the backward EXPLICITLY:
+#
+#   * A static (tick, stage) action table (`build_1f1b_tables`, computed
+#     in numpy at trace time) encodes the classic non-interleaved 1F1B
+#     order: stage s warms up with min(S-s, M) forwards, then strictly
+#     alternates backward/forward (one forward credit per completed
+#     backward). T = 2(M+S-1) ticks total; peak in-flight per stage = S.
+#   * Each stage keeps three S-slot ring buffers (slot = microbatch mod S
+#     — live microbatches are consecutive, so slots never collide): the
+#     activations received from the previous stage, the saved stage INPUT
+#     of each in-flight microbatch, and the cotangents received from the
+#     next stage.
+#   * A backward tick recomputes the stage's forward from the saved input
+#     under `jax.vjp` and applies the received cotangent — activation
+#     residuals are never stored across ticks, only inputs (the same
+#     recompute-from-boundary trade remat makes, but scheduled).
+#   * The last stage runs the loss head inside its backward tick and seeds
+#     the cotangent chain with d(loss); stage 0's input cotangent feeds
+#     the embedding vjp. Per-stage partial grads accumulate in f32 and are
+#     psum'd over the pipeline axis once, after the scan.
+#
+# Two SPMD rules keep the mesh deadlock-free (both found the hard way, on
+# the CPU in-process communicator):
+#   * Values differentiated inside a lax.cond whose predicate VARIES by
+#     stage must be `pcast` to varying first: the vma system transposes an
+#     invariant→varying cast into a hidden psum in the backward, and a
+#     psum inside a branch only some stages take hangs the rendezvous.
+#   * ppermute RESULTS must be consumed unconditionally (jnp.where, never
+#     lax.cond): XLA sinks a collective into a branch when its value is
+#     used nowhere else, with the same divergent-collective hang.
+
+
+@functools.lru_cache(maxsize=None)
+def build_1f1b_tables(n_microbatches, n_stages):
+    """Static (T, S) fwd/bwd action tables for non-interleaved 1F1B.
+
+    ``fwd[t, s]`` / ``bwd[t, s]`` is the microbatch stage ``s`` forwards /
+    backwards at tick ``t``, or -1. Greedy simulation of the textbook
+    schedule; validated invariants: per stage every microbatch is
+    forwarded and backwarded exactly once in order, dependencies are
+    respected with a one-tick transfer delay, T = 2(M+S-1), and peak
+    in-flight (forwarded-not-yet-backwarded) per stage is min(S, M)."""
+    M, S = n_microbatches, n_stages
+    n_warm = [min(S - s, M) for s in range(S)]
+    fwd_done = [[-1] * M for _ in range(S)]
+    bwd_done = [[-1] * M for _ in range(S)]
+    next_f = [0] * S
+    next_b = [0] * S
+    credits = [0] * S
+    fwd_rows, bwd_rows = [], []
+    t = 0
+    while any(next_b[s] < M for s in range(S)):
+        frow = [-1] * S
+        brow = [-1] * S
+        for s in range(S):
+            m_f, m_b = next_f[s], next_b[s]
+            can_f = m_f < M and (
+                s == 0
+                or (fwd_done[s - 1][m_f] >= 0 and fwd_done[s - 1][m_f] < t)
+            )
+            can_b = m_b < M and (
+                (s == S - 1 and fwd_done[s][m_b] >= 0 and fwd_done[s][m_b] < t)
+                or (
+                    s < S - 1
+                    and bwd_done[s + 1][m_b] >= 0
+                    and bwd_done[s + 1][m_b] < t
+                )
+            )
+            if next_f[s] < n_warm[s]:
+                if can_f:
+                    frow[s] = m_f
+            else:
+                if can_b:
+                    brow[s] = m_b
+                elif can_f and credits[s] > 0:
+                    frow[s] = m_f
+        for s in range(S):
+            if frow[s] >= 0:
+                if next_f[s] >= n_warm[s]:
+                    credits[s] -= 1
+                fwd_done[s][frow[s]] = t
+                next_f[s] += 1
+            if brow[s] >= 0:
+                bwd_done[s][brow[s]] = t
+                next_b[s] += 1
+                credits[s] += 1
+        fwd_rows.append(frow)
+        bwd_rows.append(brow)
+        t += 1
+        if t > 4 * (M + S) + 8:
+            raise RuntimeError("1f1b schedule construction did not converge")
+    return np.array(fwd_rows, np.int32), np.array(bwd_rows, np.int32)
+
+def pipeline_1f1b_grads(layer_params, x0_mbs, data_mbs, head_params,
+                        block_fn, head_fn, n_microbatches=0):
+    """Run a full fwd+bwd 1F1B pipeline; returns
+    ``(loss_sum, extras_sum, d_x0_mbs, d_layers, d_head)``.
+
+    Args:
+      layer_params: pytree with leaves stacked ``(L, ...)``, sharded on
+        the leading axis over the ``pipeline`` mesh axis.
+      x0_mbs: the per-microbatch INPUT carries (embedding already applied
+        by the caller — gathers on batch-sharded indices CHECK-fail in
+        XLA's partial-manual partitioner, so embedding lives outside the
+        manual region), float leaves ``(M, ...)``; their cotangents are
+        returned so the caller can vjp the embedding.
+      data_mbs: pytree of NON-differentiated per-microbatch companions,
+        each leaf ``(M, ...)`` (labels, segment ids, per-mb scalars) —
+        stages index the microbatch they are acting on directly, so
+        nothing integral rides the ppermute channels.
+      head_params: differentiated pytree for
+        ``head_fn(head_params, carry, data_mb) -> (loss, extras)`` where
+        ``extras`` is a tuple of metric scalars (returned summed over
+        microbatches; no gradient flows through them).
+      block_fn: ``(carry, layer, data_mb) -> carry`` — one block.
+      n_microbatches: M; 0 → the stage count.
+
+    Gradients are summed over microbatches in f32: identical semantics to
+    differentiating the GPipe schedule (equality-tested), different
+    only in schedule — peak in-flight microbatches per stage is S, not M.
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    S = pipeline_axis_size()
+    tmap = jax.tree_util.tree_map
+    if S <= 1:
+        raise ValueError("pipeline_1f1b_grads requires a pipeline axis > 1")
+    M = int(n_microbatches) if n_microbatches else S
+    n_layers = jax.tree_util.tree_leaves(layer_params)[0].shape[0]
+    if n_layers % S:
+        raise ValueError(
+            f"n_layers={n_layers} not divisible by pipeline stages (--pp) {S}"
+        )
+    fwd_np, bwd_np = build_1f1b_tables(M, S)
+    T = fwd_np.shape[0]
+    fwd_tab = jnp.asarray(fwd_np)
+    bwd_tab = jnp.asarray(bwd_np)
+
+    def local_stack(c, local_layers, data_mb):
+        def body(c, layer):
+            return block_fn(c, layer, data_mb), None
+
+        out, _ = jax.lax.scan(body, c, local_layers)
+        return out
+
+    def stage_program(local_layers, x0_mbs, data_mbs, head_params):
+        s = jax.lax.axis_index(AXIS_PIPE)
+        fwd_chain = [(i, i + 1) for i in range(S - 1)]
+        bwd_chain = [(i + 1, i) for i in range(S - 1)]
+
+        def _pv1(x):
+            vma = getattr(jax.typeof(x), "vma", frozenset())
+            if AXIS_PIPE in vma:
+                return x
+            return jax.lax.pcast(x, (AXIS_PIPE,), to="varying")
+
+        def pvary(tree):
+            return tmap(_pv1, tree)
+
+        # see module comment: differentiated replicated params must be
+        # varying BEFORE any vjp inside a stage-divergent cond
+        head_params = pvary(head_params)
+
+        def data_at(m):
+            return tmap(lambda q: q[m], data_mbs)
+
+        def x0_at(m):
+            return pvary(tmap(lambda q: q[m], x0_mbs))
+
+        # template carry for buffer allocation
+        carry0 = x0_at(0)
+
+        def zeros_carry():
+            return pvary(tmap(lambda l: jnp.zeros_like(l), carry0))
+
+        def buf():
+            return pvary(
+                tmap(lambda l: jnp.zeros((S, *l.shape), l.dtype), carry0)
+            )
+
+        zero_dlayers = pvary(
+            tmap(lambda l: jnp.zeros(l.shape, jnp.float32), local_layers)
+        )
+        # stage 0 records the input-carry cotangents here — each slot is
+        # written exactly once (no accumulation), so the buffer stays at
+        # the carry's own dtype rather than f32. Note the honest memory
+        # accounting: x0_mbs and this buffer are O(full batch) per stage
+        # (like GPipe's replicated input queue) — the O(S) 1F1B bound
+        # applies to the LAYER activations, which dominate by the layer
+        # count; sharding these two boundary buffers onto stage 0 with a
+        # rotation is possible future work.
+        zero_dx0 = pvary(tmap(lambda l: jnp.zeros_like(l), x0_mbs))
+        zero_dhead = pvary(
+            tmap(lambda l: jnp.zeros(l.shape, jnp.float32), head_params)
+        )
+        _, extras0 = jax.eval_shape(
+            lambda hp, c, d: head_fn(hp, c, d), head_params, carry0, data_at(0)
+        )
+        zero_extras = pvary(
+            tmap(lambda l: jnp.zeros(l.shape, l.dtype), extras0)
+        )
+
+        def read_slot(b, m):
+            return tmap(
+                lambda q: jax.lax.dynamic_index_in_dim(
+                    q, m % S, 0, keepdims=False
+                ),
+                b,
+            )
+
+        def write_slot(b, m, v, size=S):
+            return tmap(
+                lambda q, vv: jax.lax.dynamic_update_index_in_dim(
+                    q, vv, m % size, 0
+                ),
+                b, v,
+            )
+
+        def masked_write(b, m, v, take, size=S):
+            upd = write_slot(b, m, v, size=size)
+            return tmap(lambda n, o: jnp.where(take, n, o), upd, b)
+
+        def tick(state, t):
+            (in_buf, saved_in, ct_buf, dlayers, dx0, dhead, loss_sum,
+             extras_sum) = state
+            fm = fwd_tab[t, s]
+            bm = bwd_tab[t, s]
+            fm_c = jnp.maximum(fm, 0)
+            bm_c = jnp.maximum(bm, 0)
+
+            # ---- forward (fm >= 0): stage 0 reads its input microbatch,
+            # later stages read the activation received from s-1 ----
+            def do_fwd(_):
+                x_stage0 = x0_at(fm_c)
+                x_buf = read_slot(in_buf, fm_c)
+                x_in = tmap(
+                    lambda a, b: jnp.where(s == 0, a, b), x_stage0, x_buf
+                )
+                y = local_stack(x_in, local_layers, data_at(fm_c))
+                return pvary((x_in, y))
+
+            def skip_fwd(_):
+                return zeros_carry(), zeros_carry()
+
+            x_in, y_send = jax.lax.cond(fm >= 0, do_fwd, skip_fwd, None)
+            saved_in = masked_write(saved_in, fm_c, x_in, fm >= 0)
+
+            # ---- backward (bm >= 0): recompute-from-input vjp ----
+            def do_bwd(_):
+                x_saved = read_slot(saved_in, bm_c)
+                data_mb = data_at(bm_c)
+
+                def stack_only(x, layers):
+                    return local_stack(x, layers, data_mb)
+
+                yy, svjp = jax.vjp(stack_only, x_saved, local_layers)
+
+                # the loss head runs ONLY on the last stage (its branch is
+                # collective-free, so the stage-divergent cond is safe) —
+                # every other stage would otherwise pay the full
+                # rms_norm + vocab-projection + CE forward AND vjp per
+                # backward tick just to multiply the result by zero
+                def do_head(_):
+                    (loss, extras), hvjp = jax.vjp(
+                        lambda hp, y: head_fn(hp, y, data_mb),
+                        head_params, yy,
+                    )
+                    ct_extras = tmap(lambda e: jnp.zeros_like(e), extras)
+                    dh, ct_y = hvjp(
+                        pvary((jnp.ones((), loss.dtype), ct_extras))
+                    )
+                    return pvary((ct_y, dh, loss, extras))
+
+                def skip_head(_):
+                    return (zeros_carry(), zero_dhead,
+                            _pv1(jnp.float32(0)), zero_extras)
+
+                is_last = s == S - 1
+                ct_head, dh, mb_loss, mb_extras = jax.lax.cond(
+                    is_last, do_head, skip_head, None
+                )
+                # last stage seeds from the loss head; others apply the
+                # received cotangent for this microbatch
+                ct_recv = read_slot(ct_buf, bm_c)
+                ct_y = tmap(
+                    lambda h, r: jnp.where(is_last, h, r), ct_head, ct_recv
+                )
+                dx, dl = svjp(ct_y)
+                return pvary((dx, dl, dh, mb_loss, mb_extras))
+
+            def skip_bwd(_):
+                return (zeros_carry(), zero_dlayers, zero_dhead,
+                        _pv1(jnp.float32(0)), zero_extras)
+
+            dx_send, dl_delta, dh_delta, mb_loss, mb_extras = jax.lax.cond(
+                bm >= 0, do_bwd, skip_bwd, None
+            )
+            dlayers = tmap(
+                lambda a, d: a + d.astype(jnp.float32), dlayers, dl_delta
+            )
+            dhead = tmap(
+                lambda a, d: a + d.astype(jnp.float32), dhead, dh_delta
+            )
+            loss_sum = loss_sum + mb_loss
+            extras_sum = tmap(lambda a, d: a + d, extras_sum, mb_extras)
+
+            # stage 0's input cotangent IS this microbatch's d_x0 (the
+            # vjp cotangent already has the carry's dtype)
+            dx0 = masked_write(
+                dx0, bm_c, dx_send,
+                jnp.logical_and(bm >= 0, s == 0), size=M,
+            )
+
+            # ---- communication: see module comment — results consumed
+            # via jnp.where only ----
+            y_recv = jax.lax.ppermute(y_send, AXIS_PIPE, fwd_chain)
+            ct_recv_new = jax.lax.ppermute(dx_send, AXIS_PIPE, bwd_chain)
+            prev_fm = fwd_tab[t, jnp.maximum(s - 1, 0)]
+            in_buf = masked_write(
+                in_buf, jnp.maximum(prev_fm, 0), y_recv,
+                jnp.logical_and(s > 0, prev_fm >= 0),
+            )
+            next_bm = bwd_tab[t, jnp.minimum(s + 1, S - 1)]
+            ct_buf = masked_write(
+                ct_buf, jnp.maximum(next_bm, 0), ct_recv_new,
+                jnp.logical_and(s < S - 1, next_bm >= 0),
+            )
+            return (in_buf, saved_in, ct_buf, dlayers, dx0, dhead,
+                    loss_sum, extras_sum), None
+
+        state0 = (buf(), buf(), buf(), zero_dlayers, zero_dx0, zero_dhead,
+                  _pv1(jnp.float32(0)), zero_extras)
+        state, _ = jax.lax.scan(tick, state0, jnp.arange(T))
+        (_, _, _, dlayers, dx0, dhead, loss_sum, extras_sum) = state
+        # replicate: grads/scalars live on one stage each — one psum at end
+        loss_sum = jax.lax.psum(loss_sum, AXIS_PIPE)
+        extras_sum = tmap(lambda x: jax.lax.psum(x, AXIS_PIPE), extras_sum)
+        # the dx0 psum rides f32: XLA-CPU's AllReducePromotion CHECK-fails
+        # on sub-f32 all-reduces (same workaround as the GPipe wire dtype);
+        # values are exact either way — all but stage 0's are zeros
+        dx0 = tmap(
+            lambda x: jax.lax.psum(x.astype(jnp.float32), AXIS_PIPE).astype(
+                x.dtype
+            ),
+            dx0,
+        )
+        dhead = tmap(lambda x: jax.lax.psum(x, AXIS_PIPE), dhead)
+        return loss_sum, extras_sum, dx0, dlayers, dhead
+
+    from pyrecover_tpu.parallel.mesh import constraints_disabled
+
+    # activation sharding constraints are disabled while TRACING the stage
+    # program: a with_sharding_constraint inside the stage-divergent conds
+    # can make GSPMD insert reshard collectives only some stages execute
+    # (see mesh.constraints_disabled); propagation from the sharded inputs
+    # carries the layouts instead.
+    with constraints_disabled():
+        return jax.shard_map(
+            stage_program,
+            mesh=mesh,
+            in_specs=(P(AXIS_PIPE), P(), P(), P()),
+            out_specs=(P(), P(), P(), P(AXIS_PIPE), P()),
+            axis_names={AXIS_PIPE},
+        )(layer_params, x0_mbs, data_mbs, head_params)

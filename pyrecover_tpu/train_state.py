@@ -53,6 +53,24 @@ def create_train_state(rng, model_config, optimizer, params=None):
     )
 
 
+def _token_logprob(logprobs, safe_labels):
+    """Per-token label log-probs. Inside a manual region (the 1F1B head
+    runs under the pipeline shard_map) the vocab-dim gather on batch-
+    sharded indices CHECK-fails XLA's partial-manual partitioner — same
+    weakness models/moe.py documents — so a one-hot einsum (the form
+    every partitioner handles) replaces take_along_axis there."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is not None and not mesh.empty:
+        from pyrecover_tpu.parallel.mesh import nonmanual_axes
+
+        if len(nonmanual_axes(mesh)) != len(mesh.axis_names):
+            onehot = jax.nn.one_hot(
+                safe_labels, logprobs.shape[-1], dtype=logprobs.dtype
+            )
+            return jnp.einsum("...v,...v->...", logprobs, onehot)
+    return jnp.take_along_axis(logprobs, safe_labels[..., None], axis=-1)[..., 0]
+
+
 def masked_cross_entropy(logits, labels):
     """Sum-reduced CE over non-masked tokens / count (reference train.py:263-266).
 
@@ -61,7 +79,7 @@ def masked_cross_entropy(logits, labels):
     valid = labels != IGNORE_INDEX
     safe_labels = jnp.where(valid, labels, 0)
     logprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    token_ll = jnp.take_along_axis(logprobs, safe_labels[..., None], axis=-1)[..., 0]
+    token_ll = _token_logprob(logprobs, safe_labels)
     loss_sum = -jnp.sum(jnp.where(valid, token_ll, 0.0))
     n_valid = jnp.sum(valid)
     return loss_sum / jnp.maximum(n_valid, 1).astype(jnp.float32), n_valid
@@ -94,7 +112,7 @@ def chunked_ce(params, hidden, labels, model_config, chunk_size):
         valid = lab != IGNORE_INDEX
         safe = jnp.where(valid, lab, 0)
         logprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        ll = jnp.take_along_axis(logprobs, safe[..., None], axis=-1)[..., 0]
+        ll = _token_logprob(logprobs, safe)
         return -jnp.sum(jnp.where(valid, ll, 0.0)), jnp.sum(valid)
 
     sums, counts = jax.lax.map(per_chunk, (h_chunks, l_chunks))
@@ -108,6 +126,122 @@ def chunked_loss(params, tokens, labels, model_config, chunk_size):
 
     hidden = forward_hidden(params, tokens, model_config)
     return chunked_ce(params, hidden, labels, model_config, chunk_size)
+
+
+def _pipelined_1f1b_value_and_grad(params, batch, model_config,
+                                   loss_chunk_size):
+    """Manual value-and-grad through the explicit 1F1B pipeline schedule
+    (parallel/pipeline.py::pipeline_1f1b_grads): the embed/block/head
+    pieces of the model are handed to the schedule, which interleaves each
+    microbatch's backward as soon as its forward drains — in-flight
+    activations per stage bounded to the stage count instead of the
+    microbatch count. Numerically equivalent to differentiating the GPipe
+    schedule (equality-tested); returns ``(ce_loss, n_valid, moe_aux,
+    grads)`` with the same semantics as the AD path."""
+    from pyrecover_tpu.models.llama import (
+        _attention_fn,
+        _block,
+        rms_norm,
+    )
+    from pyrecover_tpu.ops.rope import precompute_rope
+    from pyrecover_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQ, constrain
+    from pyrecover_tpu.parallel.pipeline import (
+        pipeline_1f1b_grads,
+        pipeline_axis_size,
+    )
+    from pyrecover_tpu.utils.dtypes import resolve_dtype
+
+    cfg = model_config
+    cdt = resolve_dtype(cfg.compute_dtype)
+    B, seq_len = batch["inputs"].shape
+    S = pipeline_axis_size()
+    M = cfg.pp_microbatches or S
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    n_total = jnp.maximum(
+        jnp.sum(batch["labels"] != IGNORE_INDEX), 1
+    ).astype(jnp.float32)
+
+    cos, sin = precompute_rope(cfg.head_dim, seq_len, cfg.rope_theta)
+    attn_fn = _attention_fn(cfg)
+
+    data_mbs = {
+        "labels": batch["labels"].reshape(M, B // M, seq_len),
+        # scalar companions ride the (replicated, non-diff) data pytree so
+        # the head never closes over values from outside the shard_map
+        "n_total": jnp.broadcast_to(n_total, (M,)),
+    }
+    if batch.get("segments") is not None:
+        data_mbs["segments"] = batch["segments"].reshape(M, B // M, seq_len)
+
+    # Embedding runs OUTSIDE the pipeline's manual region (the gather on
+    # batch-sharded token indices CHECK-fails XLA's partial-manual
+    # partitioner); the schedule hands the input-carry cotangents back and
+    # the embedding vjp closes the chain here, under full-auto GSPMD.
+    def embed_all(ep):
+        x = ep["tok_embed"].astype(cdt)[batch["inputs"]]
+        # same staged reshard waypoints as forward_hidden_with_aux
+        x = constrain(x, None, None, None)
+        x = constrain(x, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, None)
+        return {
+            "x": x.reshape(M, B // M, seq_len, -1),
+            "aux": jnp.zeros((M, B // M), jnp.float32),
+        }
+
+    def block_fn(carry, layer, d):
+        new_x, aux = _block(
+            carry["x"], layer, cos=cos, sin=sin, config=cfg, attn_fn=attn_fn,
+            segment_ids=d.get("segments"),
+        )
+        return {"x": new_x, "aux": carry["aux"] + aux}
+
+    if cfg.remat:
+        policy = (
+            jax.checkpoint_policies.save_only_these_names("attn_out")
+            if cfg.remat_policy == "save-attn"
+            else jax.checkpoint_policies.nothing_saveable
+        )
+        block_fn = jax.checkpoint(block_fn, policy=policy)
+
+    def head_fn(hp, carry, d):
+        hidden = rms_norm(carry["x"], hp["final_norm"], cfg.norm_eps)
+        ce, n = chunked_ce(
+            {"output": hp["output"]}, hidden, d["labels"], cfg,
+            loss_chunk_size,
+        )
+        ce_sum = ce * jnp.maximum(n, 1).astype(jnp.float32)
+        aux_sum = jnp.sum(carry["aux"])
+        total = ce_sum / d["n_total"]
+        if cfg.n_experts > 0:
+            total = total + cfg.moe_aux_weight * aux_sum / B
+        # extras carry metric values out (no gradient flows through them)
+        return total, (jax.lax.stop_gradient(ce_sum),
+                       jax.lax.stop_gradient(aux_sum))
+
+    head_params = {
+        "final_norm": params["final_norm"],
+        "output": params["output"],
+    }
+    x0_mbs, embed_vjp = jax.vjp(embed_all, {"tok_embed": params["tok_embed"]})
+    _, (ce_total, aux_total), dx0_mbs, dlayers, dhead = pipeline_1f1b_grads(
+        params["layers"], x0_mbs, data_mbs, head_params,
+        block_fn, head_fn, n_microbatches=M,
+    )
+    (dembed,) = embed_vjp(
+        jax.tree_util.tree_map(
+            lambda d, x: d.astype(x.dtype), dx0_mbs, x0_mbs
+        )
+    )
+    grads = {
+        "tok_embed": dembed["tok_embed"],
+        "layers": dlayers,
+        "final_norm": dhead["final_norm"],
+        "output": dhead["output"],
+    }
+    grads = jax.tree_util.tree_map(
+        lambda g, p: g.astype(p.dtype), grads, params
+    )
+    return ce_total / n_total, n_total.astype(jnp.int32), aux_total / B, grads
 
 
 def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0,
@@ -131,6 +265,13 @@ def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0,
         raise ValueError(
             f"grad_accumulation_steps must be >= 1, got {grad_accumulation_steps}"
         )
+    if model_config.pp_schedule == "1f1b" and A > 1:
+        raise ValueError(
+            "--grad-accumulation-steps composes with the gpipe pipeline "
+            "schedule only; under --pp-schedule 1f1b raise "
+            "--pp-microbatches instead — 1F1B's microbatches ARE the "
+            "accumulation, with bounded in-flight activations."
+        )
 
     def micro_loss(params, inputs, labels, segments, n_total, rows_total):
         """Micro-batch objective: ``Σ_chunk CE / N_total`` (+ row-weighted
@@ -151,8 +292,17 @@ def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0,
         return total, moe_aux
 
     def step_fn(state, batch):
+        from pyrecover_tpu.parallel.pipeline import pipeline_axis_size
+
         segments = batch.get("segments")  # packed-sequence ids or None
-        if A == 1:
+        use_1f1b = (
+            model_config.pp_schedule == "1f1b" and pipeline_axis_size() > 1
+        )
+        if use_1f1b:
+            loss, n_valid, moe_aux, grads = _pipelined_1f1b_value_and_grad(
+                state.params, batch, model_config, loss_chunk_size
+            )
+        elif A == 1:
             def loss_fn(params):
                 from pyrecover_tpu.models.llama import forward_hidden_with_aux
 

@@ -109,6 +109,91 @@ def test_pipeline_forward_equals_scan_forward(devices8):
     )
 
 
+@pytest.mark.parametrize(
+    "mesh_cfg,model_over",
+    [
+        (MeshConfig(data=2, pipeline=4), {}),
+        (MeshConfig(data=2, tensor=2, pipeline=2), {}),
+        (MeshConfig(data=4, pipeline=2), {"pp_microbatches": 8}),
+    ],
+    ids=["1f1b-pp4-dp2", "1f1b-pp2-tp2-dp2", "1f1b-pp2-m8"],
+)
+def test_1f1b_schedule_matches_single_device(single_device_run, mesh_cfg,
+                                             model_over, devices8):
+    """The explicit 1F1B schedule (manual backward, recompute-from-input)
+    must be numerically transparent exactly like GPipe: same losses and
+    weights as the single-device run."""
+    cfg = dataclasses.replace(MODEL_CFG, pp_schedule="1f1b", **model_over)
+    ref_state, ref_losses = single_device_run
+    state, losses = run_steps(mesh_cfg, model_cfg=cfg)
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-4, atol=2e-4)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(ref_state.params),
+        jax.tree_util.tree_leaves(state.params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a, dtype=np.float32), np.asarray(b, dtype=np.float32),
+            rtol=2e-3, atol=2e-3,
+        )
+
+
+def test_1f1b_composes_with_moe_and_packing_segments(devices8):
+    """1F1B with an MoE model (manual-region einsum dispatch) and packed
+    segment ids in the data path: losses match the gpipe schedule run on
+    the same mesh (same math, different schedule)."""
+    moe_cfg = ModelConfig().tiny(
+        max_seq_len=32, vocab_size=128, n_layers=4, n_experts=4, moe_top_k=2
+    )
+    mesh_cfg = MeshConfig(data=4, pipeline=2)
+    _, gpipe_losses = run_steps(mesh_cfg, model_cfg=moe_cfg)
+    _, l_1f1b = run_steps(
+        mesh_cfg, model_cfg=dataclasses.replace(moe_cfg, pp_schedule="1f1b")
+    )
+    np.testing.assert_allclose(l_1f1b, gpipe_losses, rtol=2e-4, atol=2e-4)
+
+
+def test_1f1b_rejects_grad_accumulation():
+    from pyrecover_tpu.train_state import make_train_step
+    from pyrecover_tpu.optim import build_optimizer
+
+    cfg = dataclasses.replace(MODEL_CFG, pp_schedule="1f1b")
+    optimizer, _ = build_optimizer(TRAIN_CFG)
+    with pytest.raises(ValueError, match="pp-microbatches instead"):
+        make_train_step(cfg, optimizer, grad_accumulation_steps=2)
+
+
+def test_1f1b_reduces_peak_memory_remat_off(devices8):
+    """The round-4 'done' criterion: at M=32/S=4 with remat OFF, the 1F1B
+    schedule's compiled peak temp memory is measurably below GPipe's —
+    in-flight activation residuals are bounded to S microbatches instead
+    of the whole backward wave's M."""
+    from pyrecover_tpu.data import SyntheticTextDataset, StatefulSampler, DataLoader
+    from pyrecover_tpu.optim import build_optimizer
+    from pyrecover_tpu.train import init_sharded_state
+    from pyrecover_tpu.train_state import make_train_step
+
+    mesh = create_mesh(MeshConfig(data=2, pipeline=4))
+    temps = {}
+    for sched in ("gpipe", "1f1b"):
+        cfg = dataclasses.replace(
+            MODEL_CFG, pp_microbatches=32, pp_schedule=sched, remat=False
+        )
+        train_cfg = dataclasses.replace(TRAIN_CFG, batch_size=64)
+        optimizer, _ = build_optimizer(train_cfg)
+        state = init_sharded_state(jax.random.key(0), cfg, optimizer, mesh)
+        ds = SyntheticTextDataset(num_samples=64, seq_len=32,
+                                  vocab_size=cfg.vocab_size, seed=3)
+        sampler = StatefulSampler(dataset_len=64, global_batch_size=64, seed=3)
+        loader = DataLoader(ds, sampler, pad_token_id=0, mesh=mesh, prefetch=0)
+        step = make_train_step(cfg, optimizer, donate=False)
+        with jax.sharding.set_mesh(mesh):
+            _, batch = next(loader)
+            compiled = step.lower(state, batch).compile()
+        mem = compiled.memory_analysis()
+        temps[sched] = int(mem.temp_size_in_bytes)
+    assert temps["1f1b"] < temps["gpipe"] * 0.8, temps
+
+
 def test_batch_not_divisible_by_microbatches_raises(devices8):
     mesh = create_mesh(MeshConfig(data=2, pipeline=4))
     params = init_params(jax.random.key(1), MODEL_CFG)
