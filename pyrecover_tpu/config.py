@@ -98,14 +98,6 @@ class TrainConfig:
     profile_dir: str = "profiles/"
 
     def __post_init__(self):
-        if self.pack_sequences and (
-            self.attention_impl == "ring" or self.mesh.sequence > 1
-        ):
-            raise ValueError(
-                "--pack-sequences is not supported with ring attention / "
-                "--sp > 1 yet: the ring schedule has no segment-mask path. "
-                "Use sdpa or flash attention."
-            )
         if self.attention_impl == "auto":
             if self.mesh.sequence > 1:
                 attn = "ring"

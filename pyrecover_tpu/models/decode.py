@@ -1,0 +1,171 @@
+"""Incremental (KV-cached) decoding for the functional decoder.
+
+The reference has no generation path at all; round-3's ``tools/generate.py``
+re-ran the FULL training forward per emitted token (O(S) per token, a new
+compile per window shape). This module is the real inference path: a
+functional KV cache threaded through the same parameter pytree, so one
+decode step is O(1) in model FLOPs beyond attention against the cache.
+
+Design (TPU-first):
+  * The cache is a pytree of layer-stacked buffers ``(L, B, max_len, Hkv,
+    hd)`` — the same leading-layer-axis convention as the parameters, so
+    the per-layer scan zips params and cache slices together and the whole
+    decode step is ONE jitted program with static shapes (``chunk`` is a
+    static width; ``pos`` is a traced offset into the cache).
+  * ``decode_forward`` handles both prefill (chunk = prompt length, one
+    call) and steady-state decoding (chunk = 1): queries attend to every
+    cache position ``< pos + chunk`` plus the causal band inside the
+    chunk, via an iota mask — no data-dependent shapes anywhere.
+  * Attention math mirrors ops/attention.py (GQA einsums, fp32 softmax);
+    blocks mirror models/llama.py exactly (same norms, RoPE at absolute
+    positions, dense or MoE FFN), so cached decoding is equivalence-tested
+    against the training forward.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from pyrecover_tpu.models.llama import ffn_sublayer, qkv_proj, rms_norm
+from pyrecover_tpu.ops.rope import precompute_rope
+from pyrecover_tpu.utils.dtypes import resolve_dtype
+
+NEG_INF = -1e30
+
+
+def init_kv_cache(config, batch_size, max_len, dtype=None):
+    """Zeroed KV cache: {"k","v"} each (L, B, max_len, Hkv, head_dim)."""
+    cfg = config
+    dt = resolve_dtype(dtype or cfg.compute_dtype)
+    shape = (cfg.n_layers, batch_size, int(max_len), cfg.n_kv_heads,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def _cached_attention(q, k_cache, v_cache, pos, chunk, scale):
+    """q (B, C, Hq, hd) at absolute positions [pos, pos+C) against the
+    cache (B, max_len, Hkv, hd); positions >= pos+C (and the future inside
+    the chunk) are masked."""
+    b, c, hq, d = q.shape
+    max_len, hkv = k_cache.shape[1], k_cache.shape[2]
+    group = hq // hkv
+    qg = q.reshape(b, c, hkv, group, d)
+    scores = jnp.einsum(
+        "bqkgd,bskd->bkgqs", qg, k_cache,
+        preferred_element_type=jnp.float32,
+    ) * jnp.float32(scale)
+    qpos = pos + jax.lax.broadcasted_iota(jnp.int32, (c, max_len), 0)
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (c, max_len), 1)
+    mask = kpos <= qpos  # causal against the whole cache timeline
+    scores = jnp.where(mask[None, None, None], scores, jnp.float32(NEG_INF))
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bkgqs,bskd->bqkgd", probs.astype(v_cache.dtype), v_cache,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, c, hq * d).astype(q.dtype)
+
+
+def decode_forward(params, cache, tokens, pos, config):
+    """Run ``tokens`` (B, chunk) at absolute positions [pos, pos+chunk);
+    returns ``(logits, cache)`` — logits (B, chunk, vocab) fp32, cache
+    updated in those positions. ``chunk`` is static; ``pos`` may be
+    traced. One call with the whole prompt is the prefill; chunk=1 calls
+    are the steady-state decode loop.
+
+    MoE note: capacity-based token dropping is a TRAINING regularizer
+    whose effect depends on the chunk length (tokens compete for expert
+    slots within a chunk) — it would make chunked decoding diverge from
+    the full-sequence forward. Decoding therefore raises the capacity
+    factor to the no-drop point (cf = E ⇒ capacity ≥ any possible load),
+    making routing strictly per-token and the decode exactly
+    position-causal."""
+    import dataclasses
+
+    cfg = config
+    if cfg.n_experts > 0:
+        cfg = dataclasses.replace(
+            cfg, moe_capacity_factor=float(cfg.n_experts)
+        )
+    cdt = resolve_dtype(cfg.compute_dtype)
+    b, c = tokens.shape
+    hd = cfg.head_dim
+    max_len = cache["k"].shape[2]
+
+    cos_all, sin_all = precompute_rope(hd, max_len, cfg.rope_theta)
+    cos = jax.lax.dynamic_slice_in_dim(cos_all, pos, c, axis=0)
+    sin = jax.lax.dynamic_slice_in_dim(sin_all, pos, c, axis=0)
+    scale = 1.0 / (hd**0.5)
+
+    x = params["tok_embed"].astype(cdt)[tokens]
+
+    def block(x, layer_and_cache):
+        # same math as llama._block, with the cached-attention core swapped
+        # in: qkv projection + RoPE and the FFN sublayer are SHARED with
+        # the training forward (qkv_proj / ffn_sublayer), so the two paths
+        # cannot drift
+        layer, kc, vc = layer_and_cache
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q, k, v = qkv_proj(h, layer, cfg, cos, sin)
+        kc = jax.lax.dynamic_update_slice_in_dim(kc, k.astype(kc.dtype), pos, 1)
+        vc = jax.lax.dynamic_update_slice_in_dim(vc, v.astype(vc.dtype), pos, 1)
+        attn = _cached_attention(q, kc, vc, pos, c, scale)
+        x = x + attn @ layer["wo"].astype(cdt)
+        x, _ = ffn_sublayer(x, layer, cfg)
+        return x, (kc, vc)
+
+    def body(x, scanned):
+        layer, kc, vc = scanned
+        new_x, (kc, vc) = block(x, (layer, kc, vc))
+        return new_x, (kc, vc)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    hidden = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = jnp.einsum(
+        "bcd,dv->bcv", hidden, params["output"].astype(cdt),
+        preferred_element_type=jnp.float32,
+    )
+    return logits, {"k": new_k, "v": new_v}
+
+
+def generate_tokens(params, config, prompt_ids, max_new_tokens, *,
+                    temperature=0.0, seed=0, max_len=None):
+    """Greedy / temperature sampling with the KV cache: prefill the prompt
+    in one call, then one O(1) decode step per new token (two compiles
+    total). Returns the full id list (prompt + generated)."""
+    cfg = config
+    ids = [int(t) for t in prompt_ids]
+    if not ids:
+        raise ValueError("prompt must contain at least one token id")
+    total = max_len or cfg.max_seq_len
+    if len(ids) + max_new_tokens > total:
+        raise ValueError(
+            f"prompt ({len(ids)}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds the cache length {total}"
+        )
+    cache = init_kv_cache(cfg, 1, total)
+    step = jax.jit(
+        lambda p, c, t, pos: decode_forward(p, c, t, pos, cfg)
+    )
+    rng = jax.random.key(seed)
+
+    prompt = jnp.asarray([ids], dtype=jnp.int32)
+    logits, cache = step(params, cache, prompt, 0)
+    last = logits[0, -1]
+    pos = len(ids)
+    for i in range(max_new_tokens):
+        if temperature > 0:
+            rng, sub = jax.random.split(rng)
+            nxt = int(jax.random.categorical(sub, last / temperature))
+        else:
+            nxt = int(jnp.argmax(last))
+        ids.append(nxt)
+        if i + 1 >= max_new_tokens or len(ids) >= total:
+            break
+        logits, cache = step(
+            params, cache, jnp.asarray([[nxt]], dtype=jnp.int32), pos
+        )
+        last = logits[0, 0]
+        pos += 1
+    return ids

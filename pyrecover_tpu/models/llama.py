@@ -205,6 +205,41 @@ def _attention_fn(config):
     return sdpa_attention
 
 
+def qkv_proj(h, layer, config, cos, sin):
+    """Project + reshape + RoPE the q/k/v heads for one block — shared by
+    the training forward and the KV-cached decoder (models/decode.py), so
+    the two paths cannot drift."""
+    cfg = config
+    cdt = resolve_dtype(cfg.compute_dtype)
+    b, s, _ = h.shape
+    hd = cfg.head_dim
+    q = (h @ layer["wq"].astype(cdt)).reshape(b, s, cfg.n_heads, hd)
+    k = (h @ layer["wk"].astype(cdt)).reshape(b, s, cfg.n_kv_heads, hd)
+    v = (h @ layer["wv"].astype(cdt)).reshape(b, s, cfg.n_kv_heads, hd)
+    return apply_rope(q, cos, sin), apply_rope(k, cos, sin), v
+
+
+def ffn_sublayer(x, layer, config):
+    """Post-attention FFN sublayer (pre-norm residual): dense SwiGLU
+    (reference model.py:268-269) or MoE. Returns ``(x, aux)`` — shared by
+    the training forward and the KV-cached decoder."""
+    cfg = config
+    cdt = resolve_dtype(cfg.compute_dtype)
+    h = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
+    if cfg.n_experts > 0:
+        from pyrecover_tpu.models.moe import moe_ffn
+
+        y, aux = moe_ffn(
+            h, layer["router"], layer["moe_w1"], layer["moe_w3"],
+            layer["moe_w2"], cfg,
+        )
+        return x + y, aux
+    gate = jax.nn.silu(h @ layer["w1"].astype(cdt))
+    up = h @ layer["w3"].astype(cdt)
+    x = x + (gate * up) @ layer["w2"].astype(cdt)
+    return x, jnp.zeros((x.shape[0],), dtype=jnp.float32)
+
+
 def _block(x, layer, cos, sin, config, attn_fn, segment_ids=None):
     """One pre-norm transformer block (reference model.py:272-327).
 
@@ -219,14 +254,7 @@ def _block(x, layer, cos, sin, config, attn_fn, segment_ids=None):
 
     # --- attention sublayer ---
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = h @ layer["wq"].astype(cdt)
-    k = h @ layer["wk"].astype(cdt)
-    v = h @ layer["wv"].astype(cdt)
-    q = q.reshape(b, s, cfg.n_heads, hd)
-    k = k.reshape(b, s, cfg.n_kv_heads, hd)
-    v = v.reshape(b, s, cfg.n_kv_heads, hd)
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
+    q, k, v = qkv_proj(h, layer, cfg, cos, sin)
     q = constrain(q, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, AXIS_TENSOR, None)
     k = constrain(k, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, AXIS_TENSOR, None)
     v = constrain(v, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, AXIS_TENSOR, None)
@@ -239,21 +267,8 @@ def _block(x, layer, cos, sin, config, attn_fn, segment_ids=None):
     x = x + attn @ layer["wo"].astype(cdt)
     x = constrain(x, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, None)
 
-    # --- FFN sublayer: dense SwiGLU (reference model.py:268-269) or MoE ---
-    h = rms_norm(x, layer["ffn_norm"], cfg.norm_eps)
-    if cfg.n_experts > 0:
-        from pyrecover_tpu.models.moe import moe_ffn
-
-        y, aux = moe_ffn(
-            h, layer["router"], layer["moe_w1"], layer["moe_w3"],
-            layer["moe_w2"], cfg,
-        )
-        x = x + y
-    else:
-        gate = jax.nn.silu(h @ layer["w1"].astype(cdt))
-        up = h @ layer["w3"].astype(cdt)
-        x = x + (gate * up) @ layer["w2"].astype(cdt)
-        aux = jnp.zeros((b,), dtype=jnp.float32)
+    # --- FFN sublayer ---
+    x, aux = ffn_sublayer(x, layer, cfg)
     x = constrain(x, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, None)
     return x, aux
 

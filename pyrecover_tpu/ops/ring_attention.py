@@ -41,17 +41,33 @@ from pyrecover_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_TEN
 _NEG_INF = -1e30
 
 
-def _block_update(qg, k, v, q_start, k_start, scale, causal, m, l, acc):
-    """One online-softmax update of local q against one KV sub-block.
-    Shapes: qg (B, Sq, Hkv, G, D); k/v (B, Sk, Hkv, D). State m/l:
-    (B, Hkv, G, Sq, 1) f32; acc: (B, Sq, Hkv, G, D) f32."""
-    sq, sk = qg.shape[1], k.shape[1]
-    s = jnp.einsum("bqkgd,bskd->bkgqs", qg, k,
-                   preferred_element_type=jnp.float32) * jnp.float32(scale)
+def _score_mask(seg_q, seg_k, q_start, k_start, sq, sk, causal):
+    """Combined causal + packed-segment validity mask, or None. Causal is
+    (sq, sk) positional; segments add a batch-dependent (B, sq, sk) term
+    (queries attend only within their own document)."""
+    mask = None
     if causal:
         qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
         kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-        s = jnp.where(qpos >= kpos, s, jnp.float32(_NEG_INF))
+        mask = (qpos >= kpos)[None]  # (1, sq, sk)
+    if seg_q is not None:
+        seg = seg_q[:, :, None] == seg_k[:, None, :]  # (B, sq, sk)
+        mask = seg if mask is None else jnp.logical_and(mask, seg)
+    return mask
+
+
+def _block_update(qg, k, v, seg_q, seg_k, q_start, k_start, scale, causal,
+                  m, l, acc):
+    """One online-softmax update of local q against one KV sub-block.
+    Shapes: qg (B, Sq, Hkv, G, D); k/v (B, Sk, Hkv, D); seg_q/seg_k
+    (B, Sq)/(B, Sk) int32 or None. State m/l: (B, Hkv, G, Sq, 1) f32;
+    acc: (B, Sq, Hkv, G, D) f32."""
+    sq, sk = qg.shape[1], k.shape[1]
+    s = jnp.einsum("bqkgd,bskd->bkgqs", qg, k,
+                   preferred_element_type=jnp.float32) * jnp.float32(scale)
+    mask = _score_mask(seg_q, seg_k, q_start, k_start, sq, sk, causal)
+    if mask is not None:
+        s = jnp.where(mask[:, None, None], s, jnp.float32(_NEG_INF))
     m_cur = jnp.max(s, axis=-1, keepdims=True)
     m_new = jnp.maximum(m, m_cur)
     p = jnp.exp(s - m_new)
@@ -91,29 +107,38 @@ def _split_blocks(x, block):
     return x[None]
 
 
-def _chunk_update(qg, k, v, q_start, k_start, scale, causal, m, l, acc,
-                  block_kv):
+def _chunk_update(qg, k, v, seg_q, seg_k, q_start, k_start, scale, causal,
+                  m, l, acc, block_kv):
     """Consume one rotating KV chunk in flash-style sub-blocks (inner scan):
     the transient score block is (Sq × block_kv), not (Sq × Sk_chunk)."""
     kb = _split_blocks(k, block_kv)
     vb = _split_blocks(v, block_kv)
+    sb = None if seg_k is None else _split_blocks(seg_k, block_kv)
     blk = kb.shape[2]
 
     def body(carry, inp):
         m, l, acc = carry
-        i, kk, vv = inp
+        if sb is None:
+            i, kk, vv = inp
+            ss = None
+        else:
+            i, kk, vv, ss = inp
         m, l, acc = _block_update(
-            qg, kk, vv, q_start, k_start + i * blk, scale, causal, m, l, acc
+            qg, kk, vv, seg_q, ss, q_start, k_start + i * blk, scale,
+            causal, m, l, acc,
         )
         return (m, l, acc), None
 
-    (m, l, acc), _ = jax.lax.scan(
-        body, (m, l, acc), (jnp.arange(kb.shape[0]), kb, vb)
+    xs = (
+        (jnp.arange(kb.shape[0]), kb, vb)
+        if sb is None
+        else (jnp.arange(kb.shape[0]), kb, vb, sb)
     )
+    (m, l, acc), _ = jax.lax.scan(body, (m, l, acc), xs)
     return m, l, acc
 
 
-def _ring_fwd_local(q, k, v, *, axis_name, causal, scale, block_kv):
+def _ring_fwd_local(q, k, v, seg, *, axis_name, causal, scale, block_kv):
     """Per-shard forward (runs under shard_map): q/k/v hold THIS device's
     sequence chunk. Rotates KV around the ring via a scanned ppermute;
     returns (out, lse) — lse is the flash-attention residual the backward
@@ -133,21 +158,31 @@ def _ring_fwd_local(q, k, v, *, axis_name, causal, scale, block_kv):
     perm = [(i, (i + 1) % ring) for i in range(ring)]
 
     def ring_step(carry, step):
-        k_cur, v_cur, m, l, acc = carry
+        if seg is None:
+            k_cur, v_cur, m, l, acc = carry
+            seg_cur = None
+        else:
+            k_cur, v_cur, seg_cur, m, l, acc = carry
         src = (my - step) % ring  # whose chunk we currently hold
         m, l, acc = _chunk_update(
-            qg, k_cur, v_cur, q_start, src * sk, scale, causal, m, l, acc,
-            block_kv,
+            qg, k_cur, v_cur, seg, seg_cur, q_start, src * sk, scale,
+            causal, m, l, acc, block_kv,
         )
         # neighbor exchange over ICI; overlaps the next step's compute
-        # under XLA's async collective scheduling
+        # under XLA's async collective scheduling (the segment chunk — a
+        # tiny (B, Sk) int32 — rides the same rotation when packing)
         k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
         v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (k_cur, v_cur, m, l, acc), None
+        if seg is None:
+            return (k_cur, v_cur, m, l, acc), None
+        seg_cur = jax.lax.ppermute(seg_cur, axis_name, perm)
+        return (k_cur, v_cur, seg_cur, m, l, acc), None
 
-    (_, _, m, l, acc), _ = jax.lax.scan(
-        ring_step, (k, v, m0, l0, acc0), jnp.arange(ring)
+    carry0 = (
+        (k, v, m0, l0, acc0) if seg is None else (k, v, seg, m0, l0, acc0)
     )
+    out_carry, _ = jax.lax.scan(ring_step, carry0, jnp.arange(ring))
+    m, l, acc = out_carry[-3], out_carry[-2], out_carry[-1]
 
     l_safe = jnp.where(l > 0, l, 1.0)
     out = (acc / jnp.moveaxis(l_safe, 3, 1)).reshape(b, sq, hq, d)
@@ -155,17 +190,17 @@ def _ring_fwd_local(q, k, v, *, axis_name, causal, scale, block_kv):
     return out.astype(q.dtype), lse
 
 
-def _block_bwd(qg, k, v, do_g, delta, lse, q_start, k_start, scale, causal):
+def _block_bwd(qg, k, v, seg_q, seg_k, do_g, delta, lse, q_start, k_start,
+               scale, causal):
     """Recompute one KV sub-block's probabilities from (q, k, lse) and
     return (dq_contrib, dk_block, dv_block) — flash-attention backward
     algebra."""
     sq, sk = qg.shape[1], k.shape[1]
     s = jnp.einsum("bqkgd,bskd->bkgqs", qg, k,
                    preferred_element_type=jnp.float32) * jnp.float32(scale)
-    if causal:
-        qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
-        kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-        s = jnp.where(qpos >= kpos, s, jnp.float32(_NEG_INF))
+    mask = _score_mask(seg_q, seg_k, q_start, k_start, sq, sk, causal)
+    if mask is not None:
+        s = jnp.where(mask[:, None, None], s, jnp.float32(_NEG_INF))
     p = jnp.exp(s - lse)  # (B,Hkv,G,Sq,Sk); masked entries exp(-inf)=0
     dv = jnp.einsum("bkgqs,bqkgd->bskd", p, do_g,
                     preferred_element_type=jnp.float32)
@@ -179,28 +214,34 @@ def _block_bwd(qg, k, v, do_g, delta, lse, q_start, k_start, scale, causal):
     return dq, dk, dv
 
 
-def _chunk_bwd(qg, k, v, do_g, delta, lse, q_start, k_start, scale, causal,
-               block_kv):
+def _chunk_bwd(qg, k, v, seg_q, seg_k, do_g, delta, lse, q_start, k_start,
+               scale, causal, block_kv):
     """Backward over one rotating KV chunk in flash-style sub-blocks (inner
     scan), mirroring ``_chunk_update``: the transient score/prob/ds tensors
     are (Sq × block_kv) f32 — never the full (Sq × Sk_chunk) matrices,
     which matters most here because training's memory peak IS the backward."""
     kb = _split_blocks(k, block_kv)
     vb = _split_blocks(v, block_kv)
+    sb = None if seg_k is None else _split_blocks(seg_k, block_kv)
     nb, blk = kb.shape[0], kb.shape[2]
 
     def body(dq, inp):
-        i, kk, vv = inp
+        if sb is None:
+            i, kk, vv = inp
+            ss = None
+        else:
+            i, kk, vv, ss = inp
         dq_c, dk_b, dv_b = _block_bwd(
-            qg, kk, vv, do_g, delta, lse, q_start, k_start + i * blk, scale,
-            causal,
+            qg, kk, vv, seg_q, ss, do_g, delta, lse, q_start,
+            k_start + i * blk, scale, causal,
         )
         return dq + dq_c, (dk_b, dv_b)
 
+    xs = (
+        (jnp.arange(nb), kb, vb) if sb is None else (jnp.arange(nb), kb, vb, sb)
+    )
     dq, (dk_b, dv_b) = jax.lax.scan(
-        body,
-        jnp.zeros(qg.shape, dtype=jnp.float32),
-        (jnp.arange(nb), kb, vb),
+        body, jnp.zeros(qg.shape, dtype=jnp.float32), xs,
     )
     # (nb, B, blk, Hkv, D) → (B, Sk_chunk, Hkv, D)
     dk = jnp.moveaxis(dk_b, 0, 1).reshape(k.shape)
@@ -208,7 +249,7 @@ def _chunk_bwd(qg, k, v, do_g, delta, lse, q_start, k_start, scale, causal,
     return dq, dk, dv
 
 
-def _ring_bwd_local(q, k, v, out, lse, do, *, axis_name, causal, scale,
+def _ring_bwd_local(q, k, v, seg, out, lse, do, *, axis_name, causal, scale,
                     block_kv):
     """Second ring pass: dK/dV accumulators travel WITH their KV chunks and
     are home after the full rotation; dQ accumulates locally."""
@@ -233,11 +274,15 @@ def _ring_bwd_local(q, k, v, out, lse, do, *, axis_name, causal, scale,
     perm = [(i, (i + 1) % ring) for i in range(ring)]
 
     def ring_step(carry, step):
-        k_cur, v_cur, dk_cur, dv_cur, dq = carry
+        if seg is None:
+            k_cur, v_cur, dk_cur, dv_cur, dq = carry
+            seg_cur = None
+        else:
+            k_cur, v_cur, seg_cur, dk_cur, dv_cur, dq = carry
         src = (my - step) % ring
         dq_c, dk_c, dv_c = _chunk_bwd(
-            qg, k_cur, v_cur, do_g, delta, lse, q_start, src * sk, scale,
-            causal, block_kv,
+            qg, k_cur, v_cur, seg, seg_cur, do_g, delta, lse, q_start,
+            src * sk, scale, causal, block_kv,
         )
         dq = dq + dq_c
         dk_cur = dk_cur + dk_c
@@ -246,11 +291,17 @@ def _ring_bwd_local(q, k, v, out, lse, do, *, axis_name, causal, scale,
         v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
         dk_cur = jax.lax.ppermute(dk_cur, axis_name, perm)
         dv_cur = jax.lax.ppermute(dv_cur, axis_name, perm)
-        return (k_cur, v_cur, dk_cur, dv_cur, dq), None
+        if seg is None:
+            return (k_cur, v_cur, dk_cur, dv_cur, dq), None
+        seg_cur = jax.lax.ppermute(seg_cur, axis_name, perm)
+        return (k_cur, v_cur, seg_cur, dk_cur, dv_cur, dq), None
 
-    (_, _, dk, dv, dq), _ = jax.lax.scan(
-        ring_step, (k, v, dk0, dv0, dq0), jnp.arange(ring)
+    carry0 = (
+        (k, v, dk0, dv0, dq0) if seg is None
+        else (k, v, seg, dk0, dv0, dq0)
     )
+    out_carry, _ = jax.lax.scan(ring_step, carry0, jnp.arange(ring))
+    dk, dv, dq = out_carry[-3], out_carry[-2], out_carry[-1]
     return (
         dq.reshape(b, sq, hq, d).astype(q.dtype),
         dk.astype(k.dtype),
@@ -258,39 +309,47 @@ def _ring_bwd_local(q, k, v, out, lse, do, *, axis_name, causal, scale,
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _ring_attention_local(q, k, v, axis_name, causal, scale, block_kv):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _ring_attention_local(q, k, v, seg, axis_name, causal, scale, block_kv):
     out, _ = _ring_fwd_local(
-        q, k, v, axis_name=axis_name, causal=causal, scale=scale,
+        q, k, v, seg, axis_name=axis_name, causal=causal, scale=scale,
         block_kv=block_kv,
     )
     return out
 
 
-def _ring_vjp_fwd(q, k, v, axis_name, causal, scale, block_kv):
+def _ring_vjp_fwd(q, k, v, seg, axis_name, causal, scale, block_kv):
     out, lse = _ring_fwd_local(
-        q, k, v, axis_name=axis_name, causal=causal, scale=scale,
+        q, k, v, seg, axis_name=axis_name, causal=causal, scale=scale,
         block_kv=block_kv,
     )
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, seg, out, lse)
 
 
 def _ring_vjp_bwd(axis_name, causal, scale, block_kv, res, do):
-    q, k, v, out, lse = res
-    return _ring_bwd_local(
-        q, k, v, out, lse, do, axis_name=axis_name, causal=causal,
+    import numpy as np
+
+    q, k, v, seg, out, lse = res
+    dq, dk, dv = _ring_bwd_local(
+        q, k, v, seg, out, lse, do, axis_name=axis_name, causal=causal,
         scale=scale, block_kv=block_kv,
     )
+    dseg = None if seg is None else np.zeros(seg.shape, jax.dtypes.float0)
+    return dq, dk, dv, dseg
 
 
 _ring_attention_local.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
 
 
 def ring_attention(q, k, v, *, causal=True, scale=None, axis_name=AXIS_SEQ,
-                   block_kv=512):
+                   block_kv=512, segment_ids=None):
     """Drop-in for ``sdpa_attention``: shards the sequence dimension over the
     ``sequence`` mesh axis via shard_map + a scanned ppermute ring. Falls
-    back to the XLA path when no mesh / a size-1 sequence axis is in scope."""
+    back to the XLA path when no mesh / a size-1 sequence axis is in scope.
+    ``segment_ids`` (batch, seq) enables packed-sequence masking: the
+    sequence-sharded segment chunk rotates around the ring alongside its
+    KV chunk (a tiny int32 array on the same ICI hops), so packing and
+    sequence parallelism compose."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
 
@@ -298,7 +357,8 @@ def ring_attention(q, k, v, *, causal=True, scale=None, axis_name=AXIS_SEQ,
     if mesh is None or mesh.empty or mesh.shape.get(axis_name, 1) == 1:
         from pyrecover_tpu.ops.attention import sdpa_attention
 
-        return sdpa_attention(q, k, v, causal=causal, scale=scale)
+        return sdpa_attention(q, k, v, causal=causal, scale=scale,
+                              segment_ids=segment_ids)
 
     batch_axes = tuple(a for a in (AXIS_DATA, AXIS_FSDP) if a in mesh.axis_names)
     head_axis = AXIS_TENSOR if AXIS_TENSOR in mesh.axis_names else None
@@ -308,7 +368,14 @@ def ring_attention(q, k, v, *, causal=True, scale=None, axis_name=AXIS_SEQ,
         _ring_attention_local, axis_name=axis_name, causal=causal,
         scale=scale, block_kv=block_kv,
     )
+    if segment_ids is None:
+        return jax.shard_map(
+            lambda q, k, v: body(q, k, v, None),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
+    seg_spec = P(batch_axes or None, axis_name)
     return jax.shard_map(
-        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
-    )(q, k, v)
+        body, mesh=mesh, in_specs=(spec, spec, spec, seg_spec),
+        out_specs=spec, check_vma=False,
+    )(q, k, v, segment_ids.astype(jnp.int32))

@@ -171,14 +171,34 @@ def test_packing_near_full_token_utilization(parquet_file):
     assert u_packed > u_padded + 0.2, (u_packed, u_padded)
 
 
-def test_pack_sequences_rejects_ring():
-    from pyrecover_tpu.config import TrainConfig
+@pytest.mark.slow
+def test_packing_composes_with_ring_attention(parquet_file, tmp_path,
+                                              tiny_tokenizer_loader):
+    """Packing + sequence parallelism: the packed segment chunks rotate
+    around the ring with their KV chunks, so --pack-sequences with --sp 2
+    must produce the SAME losses as the packed single-device run."""
     from pyrecover_tpu.parallel.mesh import MeshConfig
+    from pyrecover_tpu.train import train
 
-    with pytest.raises(ValueError, match="pack-sequences"):
-        TrainConfig(pack_sequences=True, attention_impl="ring")
-    with pytest.raises(ValueError, match="pack-sequences"):
-        TrainConfig(pack_sequences=True, mesh=MeshConfig(data=4, sequence=2))
+    base = dict(training_steps=3, checkpoint_frequency=-1, log_loss_to_csv=True,
+                logging_frequency=1)
+    cfg_ref = _packed_train_cfg(tmp_path / "ref", parquet_file, **base)
+    train(cfg_ref)
+
+    cfg_sp = _packed_train_cfg(tmp_path / "sp", parquet_file, **base)
+    cfg_sp.mesh = MeshConfig(data=4, sequence=2)
+    cfg_sp.attention_impl = "auto"
+    cfg_sp.__post_init__()
+    assert cfg_sp.model.attention_impl == "ring"
+    train(cfg_sp)
+
+    import csv as csvlib
+
+    ref_rows = list(csvlib.reader(open(tmp_path / "ref" / "pk" / "pk_loss_log.csv")))
+    sp_rows = list(csvlib.reader(open(tmp_path / "sp" / "pk" / "pk_loss_log.csv")))
+    ref_losses = [float(r[1]) for r in ref_rows[1:]]
+    sp_losses = [float(r[1]) for r in sp_rows[1:]]
+    np.testing.assert_allclose(sp_losses, ref_losses, rtol=5e-4, atol=5e-4)
 
 
 def _packed_train_cfg(tmp_path, parquet_file, **overrides):

@@ -1,10 +1,10 @@
 """Sample text from a pyrecover_tpu checkpoint (either format).
 
 Beyond-parity utility (the reference has no generation path at all): loads
-a checkpoint's params, then decodes greedily or with temperature sampling.
-Decoding re-runs the full forward per generated token (no KV cache — this
-is a verification/demo tool, not a serving engine; the training forward is
-deliberately cache-free).
+a checkpoint's params, then decodes greedily or with temperature sampling
+through the KV-cached incremental decoder (models/decode.py) — prefill is
+one call over the prompt, each new token is an O(1) step, two compiles
+total regardless of length.
 
 Usage:
   python tools/generate.py CKPT --model llama-150m --prompt-ids 1,2,3 \
@@ -69,30 +69,31 @@ def load_params(path, model_cfg):
 
 
 def generate(params, model_cfg, prompt_ids, max_new_tokens, temperature, seed):
-    import jax
-    import jax.numpy as jnp
+    from pyrecover_tpu.models.decode import generate_tokens
 
-    from pyrecover_tpu.models.llama import forward
-
-    ids = list(int(t) for t in prompt_ids)
-    rng = jax.random.key(seed)
-    # fixed-shape window (right-padded to max_seq_len) → exactly ONE compile;
-    # causal attention makes the positions past the read index inert
-    fwd = jax.jit(lambda p, t: forward(p, t, model_cfg))
+    # the cache covers max_seq_len positions; the library API raises on
+    # overflow, but the CLI clamps like the old sliding-window behavior:
+    # keep the prompt TAIL and cap the new-token budget, with a warning
     L = model_cfg.max_seq_len
-    for _ in range(max_new_tokens):
-        window = ids[-L:]
-        pos = len(window) - 1
-        padded = window + [0] * (L - len(window))
-        tokens = jnp.asarray([padded], dtype=jnp.int32)
-        logits = fwd(params, tokens)[0, pos]
-        if temperature > 0:
-            rng, sub = jax.random.split(rng)
-            nxt = int(jax.random.categorical(sub, logits / temperature))
-        else:
-            nxt = int(jnp.argmax(logits))
-        ids.append(nxt)
-    return ids
+    prompt_ids = list(prompt_ids)
+    max_new_tokens = int(max_new_tokens)
+    dropped_prefix = []
+    if max_new_tokens >= L:
+        print(f"warning: --max-new-tokens capped to {L - 1} "
+              f"(max-seq-len {L})", file=sys.stderr)
+        max_new_tokens = L - 1
+    if len(prompt_ids) + max_new_tokens > L:
+        keep = L - max_new_tokens
+        dropped_prefix = prompt_ids[:-keep]
+        print(f"warning: prompt truncated to its last {keep} tokens to fit "
+              f"max-seq-len {L} with {max_new_tokens} new tokens",
+              file=sys.stderr)
+        prompt_ids = prompt_ids[-keep:]
+    out = generate_tokens(
+        params, model_cfg, prompt_ids, max_new_tokens,
+        temperature=temperature, seed=seed,
+    )
+    return dropped_prefix + out
 
 
 def main(argv=None):
