@@ -19,7 +19,6 @@ import queue
 import threading
 
 import jax
-import numpy as np
 from jax.sharding import NamedSharding
 
 from pyrecover_tpu.data.collate import collate_clm

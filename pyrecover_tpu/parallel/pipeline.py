@@ -45,7 +45,7 @@ tick, ≈ M+S-1 of them), which neither buffer layout touches. Block remat
 the explicit 1F1B schedule below (``--pp-schedule 1f1b``), which bounds
 in-flight microbatches per stage to S by construction — measured at
 M=32/S=4 with remat OFF (tiny test model, same ``memory_analysis``):
-12.67 MB GPipe temp vs 1.07 MB 1F1B, an 11.8× reduction
+12.67 MB GPipe temp vs 0.89 MB 1F1B, a 14.2× reduction (stage-sharded boundary queues included)
 (tests/test_pipeline.py::test_1f1b_reduces_peak_memory_remat_off).
 """
 
@@ -64,6 +64,26 @@ from pyrecover_tpu.parallel.mesh import AXIS_PIPE
 # Read at TRACE time — callers flipping it must re-jit (a cached executable
 # keeps whichever layout it was traced with).
 FORCE_REPLICATED_BUFFERS = False
+
+
+def interleave_queue(tree, M, S):
+    """(M, ...) microbatch-major leaves → ring-queue layout: global row
+    s*(M/S)+j holds microbatch j*S+s (shared by the GPipe queued path and
+    the 1F1B boundary queues)."""
+    return jax.tree_util.tree_map(
+        lambda l: jnp.swapaxes(
+            l.reshape(M // S, S, *l.shape[1:]), 0, 1
+        ).reshape(M, *l.shape[1:]),
+        tree,
+    )
+
+
+def uninterleave_rows(tree, M, S):
+    """Inverse of the queue landing layout: global row
+    ((-m) mod S)*(M/S) + m//S holds microbatch m."""
+    m_idx = np.arange(M)
+    inv = ((-m_idx) % S) * (M // S) + m_idx // S
+    return jax.tree_util.tree_map(lambda l: l[jnp.asarray(inv)], tree)
 
 
 def pipeline_axis_size():
@@ -253,12 +273,7 @@ def pipeline_blocks(layer_params, x, block_fn, n_microbatches=0):
     if sharded_queues:
         # queue layout: element [s, j] = microbatch j*S + s, stage dim
         # sharded over the pipeline axis
-        inq = tmap(
-            lambda l: jnp.swapaxes(
-                l.reshape(M // S, S, *l.shape[1:]), 0, 1
-            ).reshape(M, *l.shape[1:]),
-            mbs,
-        )
+        inq = interleave_queue(mbs, M, S)
         outq = jax.shard_map(
             stage_program_queued,
             mesh=mesh,
@@ -266,10 +281,7 @@ def pipeline_blocks(layer_params, x, block_fn, n_microbatches=0):
             out_specs=P(AXIS_PIPE),
             axis_names={AXIS_PIPE},
         )(layer_params, inq)
-        # outq global row s*(M/S)+j holds microbatch j*S + ((S-s) % S)
-        m_idx = np.arange(M)
-        inv = ((-m_idx) % S) * (M // S) + m_idx // S
-        out = tmap(lambda l: l[jnp.asarray(inv)], outq)
+        out = uninterleave_rows(outq, M, S)
     else:
         out = jax.shard_map(
             stage_program_replicated,
@@ -426,6 +438,22 @@ def pipeline_1f1b_grads(layer_params, x0_mbs, data_mbs, head_params,
     T = fwd_np.shape[0]
     fwd_tab = jnp.asarray(fwd_np)
     bwd_tab = jnp.asarray(bwd_np)
+    # Boundary-queue sharding (the x0 inputs and their cotangents): when
+    # M % S == 0 each stage holds an (M/S)-slot slice of both queues and
+    # the slices rotate over the pipeline ring — the input queue rotates
+    # toward stage 0 once per stage-0 FORWARD (content of microbatch m,
+    # initially at stage m mod S slot m//S, reaches stage 0 exactly when
+    # its m prior rotations have run), the cotangent queue rotates forward
+    # once per stage-0 BACKWARD (microbatch m's write at stage 0 then
+    # travels M-m hops to land at home row ((-m) mod S, m//S) — the same
+    # inverse permutation as the GPipe output queue). Rotation ticks are
+    # STATIC table lookups; the permutes run unconditionally with
+    # where-masked adoption (see the module's collective rules). This
+    # removes the last O(M)-replicated term: per-stage boundary memory is
+    # 2·(M/S) microbatches instead of 2·M.
+    sharded_io = M % S == 0 and not FORCE_REPLICATED_BUFFERS
+    rot_in_tab = jnp.asarray(fwd_np[:, 0] >= 0)
+    rot_out_tab = jnp.asarray(bwd_np[:, 0] >= 0)
 
     def local_stack(c, local_layers, data_mb):
         def body(c, layer):
@@ -438,6 +466,8 @@ def pipeline_1f1b_grads(layer_params, x0_mbs, data_mbs, head_params,
         s = jax.lax.axis_index(AXIS_PIPE)
         fwd_chain = [(i, i + 1) for i in range(S - 1)]
         bwd_chain = [(i + 1, i) for i in range(S - 1)]
+        ring_fwd = [(i, (i + 1) % S) for i in range(S)]
+        ring_back = [(i, (i - 1) % S) for i in range(S)]
 
         def _pv1(x):
             vma = getattr(jax.typeof(x), "vma", frozenset())
@@ -455,11 +485,21 @@ def pipeline_1f1b_grads(layer_params, x0_mbs, data_mbs, head_params,
         def data_at(m):
             return tmap(lambda q: q[m], data_mbs)
 
-        def x0_at(m):
-            return pvary(tmap(lambda q: q[m], x0_mbs))
+        def x0_at(queue, m):
+            # sharded: local slot m // S (the rotation schedule has brought
+            # microbatch m under stage 0); replicated: direct row m
+            idx = m // S if sharded_io else m
+            return pvary(
+                tmap(
+                    lambda q: jax.lax.dynamic_index_in_dim(
+                        q, idx, 0, keepdims=False
+                    ),
+                    queue,
+                )
+            )
 
         # template carry for buffer allocation
-        carry0 = x0_at(0)
+        carry0 = x0_at(x0_mbs, 0)
 
         def zeros_carry():
             return pvary(tmap(lambda l: jnp.zeros_like(l), carry0))
@@ -474,12 +514,9 @@ def pipeline_1f1b_grads(layer_params, x0_mbs, data_mbs, head_params,
         )
         # stage 0 records the input-carry cotangents here — each slot is
         # written exactly once (no accumulation), so the buffer stays at
-        # the carry's own dtype rather than f32. Note the honest memory
-        # accounting: x0_mbs and this buffer are O(full batch) per stage
-        # (like GPipe's replicated input queue) — the O(S) 1F1B bound
-        # applies to the LAYER activations, which dominate by the layer
-        # count; sharding these two boundary buffers onto stage 0 with a
-        # rotation is possible future work.
+        # the carry's own dtype rather than f32. Sharded (M % S == 0):
+        # each stage carries only its (M/S)-slot slice of the rotating
+        # queue; replicated fallback otherwise.
         zero_dx0 = pvary(tmap(lambda l: jnp.zeros_like(l), x0_mbs))
         zero_dhead = pvary(
             tmap(lambda l: jnp.zeros(l.shape, jnp.float32), head_params)
@@ -512,7 +549,7 @@ def pipeline_1f1b_grads(layer_params, x0_mbs, data_mbs, head_params,
             return tmap(lambda n, o: jnp.where(take, n, o), upd, b)
 
         def tick(state, t):
-            (in_buf, saved_in, ct_buf, dlayers, dx0, dhead, loss_sum,
+            (x0q, in_buf, saved_in, ct_buf, dlayers, dx0, dhead, loss_sum,
              extras_sum) = state
             fm = fwd_tab[t, s]
             bm = bwd_tab[t, s]
@@ -522,7 +559,7 @@ def pipeline_1f1b_grads(layer_params, x0_mbs, data_mbs, head_params,
             # ---- forward (fm >= 0): stage 0 reads its input microbatch,
             # later stages read the activation received from s-1 ----
             def do_fwd(_):
-                x_stage0 = x0_at(fm_c)
+                x_stage0 = x0_at(x0q, fm_c)
                 x_buf = read_slot(in_buf, fm_c)
                 x_in = tmap(
                     lambda a, b: jnp.where(s == 0, a, b), x_stage0, x_buf
@@ -598,8 +635,9 @@ def pipeline_1f1b_grads(layer_params, x0_mbs, data_mbs, head_params,
             # stage 0's input cotangent IS this microbatch's d_x0 (the
             # vjp cotangent already has the carry's dtype)
             dx0 = masked_write(
-                dx0, bm_c, dx_send,
-                jnp.logical_and(bm >= 0, s == 0), size=M,
+                dx0, bm_c // S if sharded_io else bm_c, dx_send,
+                jnp.logical_and(bm >= 0, s == 0),
+                size=M // S if sharded_io else M,
             )
 
             # ---- communication: see module comment — results consumed
@@ -616,25 +654,43 @@ def pipeline_1f1b_grads(layer_params, x0_mbs, data_mbs, head_params,
                 ct_buf, jnp.maximum(next_bm, 0), ct_recv_new,
                 jnp.logical_and(s < S - 1, next_bm >= 0),
             )
-            return (in_buf, saved_in, ct_buf, dlayers, dx0, dhead,
+
+            if sharded_io:
+                # rotate the boundary queues on their static schedules:
+                # permutes run unconditionally (collective rules), the
+                # rotated value is adopted via where
+                x0q_rot = tmap(
+                    lambda q: jax.lax.ppermute(q, AXIS_PIPE, ring_back), x0q
+                )
+                x0q = tmap(
+                    lambda n, o: jnp.where(rot_in_tab[t], n, o), x0q_rot, x0q
+                )
+                dx0_rot = tmap(
+                    lambda q: jax.lax.ppermute(q, AXIS_PIPE, ring_fwd), dx0
+                )
+                dx0 = tmap(
+                    lambda n, o: jnp.where(rot_out_tab[t], n, o), dx0_rot, dx0
+                )
+            return (x0q, in_buf, saved_in, ct_buf, dlayers, dx0, dhead,
                     loss_sum, extras_sum), None
 
-        state0 = (buf(), buf(), buf(), zero_dlayers, zero_dx0, zero_dhead,
-                  _pv1(jnp.float32(0)), zero_extras)
+        state0 = (pvary(x0_mbs), buf(), buf(), buf(), zero_dlayers,
+                  zero_dx0, zero_dhead, _pv1(jnp.float32(0)), zero_extras)
         state, _ = jax.lax.scan(tick, state0, jnp.arange(T))
-        (_, _, _, dlayers, dx0, dhead, loss_sum, extras_sum) = state
+        (_, _, _, _, dlayers, dx0, dhead, loss_sum, extras_sum) = state
         # replicate: grads/scalars live on one stage each — one psum at end
         loss_sum = jax.lax.psum(loss_sum, AXIS_PIPE)
         extras_sum = tmap(lambda x: jax.lax.psum(x, AXIS_PIPE), extras_sum)
-        # the dx0 psum rides f32: XLA-CPU's AllReducePromotion CHECK-fails
-        # on sub-f32 all-reduces (same workaround as the GPipe wire dtype);
-        # values are exact either way — all but stage 0's are zeros
-        dx0 = tmap(
-            lambda x: jax.lax.psum(x.astype(jnp.float32), AXIS_PIPE).astype(
-                x.dtype
-            ),
-            dx0,
-        )
+        if not sharded_io:
+            # replicated fallback: only stage 0's rows are nonzero. The
+            # psum rides f32: XLA-CPU's AllReducePromotion CHECK-fails on
+            # sub-f32 all-reduces (same workaround as the GPipe wire dtype)
+            dx0 = tmap(
+                lambda x: jax.lax.psum(
+                    x.astype(jnp.float32), AXIS_PIPE
+                ).astype(x.dtype),
+                dx0,
+            )
         dhead = tmap(lambda x: jax.lax.psum(x, AXIS_PIPE), dhead)
         return loss_sum, extras_sum, dx0, dlayers, dhead
 
@@ -645,11 +701,21 @@ def pipeline_1f1b_grads(layer_params, x0_mbs, data_mbs, head_params,
     # can make GSPMD insert reshard collectives only some stages execute
     # (see mesh.constraints_disabled); propagation from the sharded inputs
     # carries the layouts instead.
+    if sharded_io:
+        x0_in = interleave_queue(x0_mbs, M, S)
+        x0_spec = dx0_spec = P(AXIS_PIPE)
+    else:
+        x0_in = x0_mbs
+        x0_spec = dx0_spec = P()
+
     with constraints_disabled():
-        return jax.shard_map(
+        loss_sum, extras_sum, dx0, dlayers, dhead = jax.shard_map(
             stage_program,
             mesh=mesh,
-            in_specs=(P(AXIS_PIPE), P(), P(), P()),
-            out_specs=(P(), P(), P(), P(AXIS_PIPE), P()),
+            in_specs=(P(AXIS_PIPE), x0_spec, P(), P()),
+            out_specs=(P(), P(), dx0_spec, P(AXIS_PIPE), P()),
             axis_names={AXIS_PIPE},
-        )(layer_params, x0_mbs, data_mbs, head_params)
+        )(layer_params, x0_in, data_mbs, head_params)
+    if sharded_io:
+        dx0 = uninterleave_rows(dx0, M, S)
+    return loss_sum, extras_sum, dx0, dlayers, dhead

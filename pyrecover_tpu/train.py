@@ -28,13 +28,11 @@ from pyrecover_tpu.checkpoint import (
 from pyrecover_tpu.config import TrainConfig, get_args
 from pyrecover_tpu.data import DataLoader, StatefulSampler, SyntheticTextDataset
 from pyrecover_tpu.metrics import LossCSVLogger, ThroughputMeter, WallTimeTotals
-from pyrecover_tpu.models.llama import init_params
 from pyrecover_tpu.optim import build_optimizer
 from pyrecover_tpu.parallel.mesh import create_mesh, initialize_distributed
-from pyrecover_tpu.parallel.sharding import param_pspecs, _leaf_rule
+from pyrecover_tpu.parallel.sharding import _leaf_rule
 from pyrecover_tpu.preempt import PreemptionWatcher, write_requeue_marker
 from pyrecover_tpu.train_state import (
-    TrainState,
     create_train_state,
     make_eval_step,
     make_train_step,

@@ -152,6 +152,20 @@ def test_1f1b_composes_with_moe_and_packing_segments(devices8):
     np.testing.assert_allclose(l_1f1b, gpipe_losses, rtol=2e-4, atol=2e-4)
 
 
+def test_1f1b_replicated_queue_fallback(devices8):
+    """M % S != 0 (M=3, S=2) uses the replicated boundary-queue fallback;
+    it must be just as numerically transparent."""
+    cfg = dataclasses.replace(
+        MODEL_CFG, pp_schedule="1f1b", pp_microbatches=3
+    )
+    train_cfg = dataclasses.replace(TRAIN_CFG, batch_size=12)
+    _, ref_losses = run_train_steps(None, MODEL_CFG, train_cfg, data_seed=9)
+    _, losses = run_train_steps(
+        MeshConfig(data=4, pipeline=2), cfg, train_cfg, data_seed=9
+    )
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-4, atol=2e-4)
+
+
 def test_1f1b_rejects_grad_accumulation():
     from pyrecover_tpu.train_state import make_train_step
     from pyrecover_tpu.optim import build_optimizer
