@@ -49,6 +49,14 @@ SUPPORTED_FORMATS = (1, 2)  # v1 (msgpack) stays readable
 MAGIC = b"PYRCKPT2"
 
 
+class CheckpointStructureError(ValueError):
+    """The checkpoint decoded fine but does not FIT the target state
+    (leaf count / shape mismatch) — a configuration error, not file
+    corruption. The latest-resume fallback must NOT skip past these:
+    every candidate would fail identically and the run would silently
+    restart from step 0 with the wrong model."""
+
+
 def _leaf_to_numpy(leaf):
     if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
         from jax.experimental import multihost_utils
@@ -332,6 +340,12 @@ def read_ckpt_raw(path, *, check_version=True):
         data, _ = native_io.read_file(path)  # parallel pread
     else:
         data = path.read_bytes()
+    return _decode_ckpt_bytes(data, check_version=check_version)
+
+
+def _decode_ckpt_bytes(data, *, check_version=True):
+    """Decode an in-memory checkpoint buffer (both formats); see
+    ``read_ckpt_raw``."""
     if data[: len(MAGIC)] == MAGIC:
         off = len(MAGIC)
         mlen = int.from_bytes(data[off : off + 8], "little")
@@ -359,6 +373,46 @@ def read_ckpt_raw(path, *, check_version=True):
     leaves = [raw["leaves"][str(i)] for i in range(meta["num_leaves"])]
     paths = meta.get("paths") or [f"leaf{i}" for i in range(len(leaves))]
     return meta, paths, leaves
+
+
+def precheck_ckpt_vanilla(path, *, verify=False):
+    """Host-LOCAL integrity check (no collectives): one read of the file,
+    checksummed in memory against the sidecar whenever one exists (or
+    required when ``verify`` demands it), and the v2 container's frame
+    structure walked on the same buffer (zero-copy views, no second
+    read). Returns (ok, reason). Used by the latest-resume fallback to
+    agree on a candidate on host 0 BEFORE every host enters the
+    collective load (a per-host exception inside the load would
+    desynchronize the barrier protocol on pods)."""
+    from pyrecover_tpu.utils import xxh
+
+    path = Path(path)
+    try:
+        data = path.read_bytes()
+        sidecar = _sidecar(path)
+        if sidecar.exists():
+            expected = sidecar.read_text().strip()
+            algo, param, digest = expected.split(":", 2)
+            if algo == "xxh64tree":
+                from pyrecover_tpu.checkpoint import native_io
+
+                chunk = int(param)
+                actual = (
+                    native_io.tree_hash(data, chunk=chunk)
+                    if native_io.available()
+                    else xxh.tree_hash_bytes(data, chunk)
+                )
+                ok = f"{actual:016x}" == digest
+            else:
+                ok = hashlib.sha256(data).hexdigest() == digest
+            if not ok:
+                return False, "checksum mismatch"
+        elif verify:
+            return False, f"checksum sidecar missing: {sidecar}"
+        _decode_ckpt_bytes(data)  # frame walk on the same buffer
+    except Exception as e:
+        return False, f"{type(e).__name__}: {e}"
+    return True, ""
 
 
 def load_ckpt_vanilla(path, target_state, *, verify=False):
@@ -404,14 +458,14 @@ def load_ckpt_vanilla(path, target_state, *, verify=False):
 
     leaves, treedef = jax.tree_util.tree_flatten(target_state)
     if meta["num_leaves"] != len(leaves):
-        raise ValueError(
+        raise CheckpointStructureError(
             f"Checkpoint has {meta['num_leaves']} leaves, target expects {len(leaves)}"
         )
 
     restored = []
     for tgt, src in zip(leaves, np_leaves):
         if tuple(tgt.shape) != tuple(src.shape):
-            raise ValueError(
+            raise CheckpointStructureError(
                 f"Shape mismatch on restore: checkpoint {src.shape} vs target {tgt.shape}"
             )
         src = src.astype(tgt.dtype)

@@ -21,7 +21,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from pyrecover_tpu.checkpoint import (
     ShardedCheckpointer,
     checkpoint_path,
-    get_latest_checkpoint,
+    list_checkpoints,
     load_ckpt_vanilla,
     save_ckpt_vanilla,
 )
@@ -308,29 +308,104 @@ def train(config: TrainConfig):
         return int(step) // bpe if bpe else 0
 
     # ---- resume (reference train.py:195-212) -------------------------------
+    # "latest" walks candidates newest→oldest and FALLS BACK past a
+    # corrupt/truncated/torn file — exactly what a crash during or after
+    # the newest save leaves behind; the checksum/decode pre-check catches
+    # it and the fallback turns it into a recovery instead of a dead job.
+    # Multi-host safety: corruption is judged by a host-LOCAL pre-check on
+    # host 0 and the verdict broadcast, so every host enters the collective
+    # load for the SAME candidate (a per-host exception inside the load
+    # would desynchronize the barrier protocol). A structural mismatch
+    # (CheckpointStructureError: wrong leaf count/shapes = wrong model
+    # config) fails hard — every candidate would fail identically and a
+    # silent fresh start would let retention pruning destroy the intact
+    # checkpoints it skipped. An explicitly named checkpoint also fails
+    # hard: the user asked for THAT file.
     start_step = 0
     if config.resume_from_checkpoint:
+        from pyrecover_tpu.checkpoint.vanilla import (
+            CheckpointStructureError,
+            precheck_ckpt_vanilla,
+        )
+        from pyrecover_tpu.parallel.mesh import broadcast_host0_scalar
+
         t0 = time.monotonic()
         target = config.resume_from_checkpoint
-        if target == "latest":
-            target = get_latest_checkpoint(
+        explicit = target != "latest"
+        if explicit:
+            candidates = [target]
+        else:
+            candidates = list_checkpoints(
                 exp_dir, sharded=config.sharded_checkpoint
-            )
-            if target is None:
+            )[::-1]
+            if not candidates:
                 log_host0("No checkpoint found in %s; starting fresh", exp_dir)
-        if target is not None:
-            if config.sharded_checkpoint:
-                state, sampler_meta, meta = sharded_ckptr.restore(target, state)
-            else:
-                state, sampler_meta, meta = load_ckpt_vanilla(
-                    target, state, verify=config.verify_checkpoints
+        restored = not candidates
+        for cand in candidates:
+            prechecked = False
+            if not explicit and not config.sharded_checkpoint:
+                # host-0 verdict, agreed everywhere, BEFORE any collective
+                ok, reason = True, ""
+                if jax.process_index() == 0:
+                    ok, reason = precheck_ckpt_vanilla(
+                        cand, verify=config.verify_checkpoints
+                    )
+                if not bool(broadcast_host0_scalar(ok)):
+                    log_host0(
+                        "Checkpoint %s failed integrity pre-check (%s); "
+                        "falling back to the previous one", cand, reason,
+                        level=30,  # WARNING
+                    )
+                    continue
+                prechecked = True
+            try:
+                if config.sharded_checkpoint:
+                    state, sampler_meta, meta = sharded_ckptr.restore(
+                        cand, state
+                    )
+                else:
+                    # single-process: the pre-check just checksummed the
+                    # same bytes — don't pay a second verification pass
+                    # (multi-host keeps the in-load verify: hosts != 0
+                    # read the file themselves)
+                    verify = config.verify_checkpoints and not (
+                        prechecked and jax.process_count() == 1
+                    )
+                    state, sampler_meta, meta = load_ckpt_vanilla(
+                        cand, state, verify=verify
+                    )
+            except Exception as e:
+                if (
+                    explicit
+                    or isinstance(e, CheckpointStructureError)
+                    or jax.process_count() > 1
+                ):
+                    # explicit request, wrong-model-config, or a pod (where
+                    # a mid-load divergence cannot be recovered safely)
+                    raise
+                log_host0(
+                    "Checkpoint %s failed to restore (%s: %s); falling back "
+                    "to the previous one", cand, type(e).__name__, e,
+                    level=30,  # WARNING
                 )
+                continue
             start_step = int(meta.get("step", int(np.asarray(state.step))))
             sampler.seek(sampler_meta.get("consumed", start_step))
             totals.ckpt_load_s += time.monotonic() - t0
             log_host0(
-                "Resumed from %s at step %d (%.2f s)", target, start_step,
+                "Resumed from %s at step %d (%.2f s)", cand, start_step,
                 totals.ckpt_load_s,
+            )
+            restored = True
+            break
+        if not restored:
+            # refuse to run: a fresh start would save new checkpoints and
+            # retention pruning would then delete the (possibly still
+            # recoverable) old ones
+            raise RuntimeError(
+                f"every checkpoint in {exp_dir} failed to restore; refusing "
+                "to start fresh over existing checkpoints — inspect them "
+                "with tools/inspect_checkpoint.py or move them aside"
             )
 
     loader = DataLoader(

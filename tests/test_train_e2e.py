@@ -136,6 +136,72 @@ def test_timeaware_stop_and_requeue(tmp_path):
     assert not (exp / DONE_MARKER).exists()
 
 
+def test_resume_falls_back_past_corrupt_checkpoint(tmp_path, caplog):
+    """A crash can tear the newest checkpoint (or corrupt it on disk);
+    resume from 'latest' must fall back to the previous good one instead
+    of dying — recovery is the project's identity. An explicitly named
+    checkpoint still fails hard."""
+    import logging
+
+    cfg = tiny_config(tmp_path, training_steps=8, checkpoint_frequency=4)
+    train(cfg)
+    exp = tmp_path / "e2e"
+    newest = exp / "ckpt_8_final.ckpt"
+    older = exp / "ckpt_4.ckpt"
+    assert newest.exists() and older.exists()
+    # corrupt the newest: truncate half the file (checksum + decode fail)
+    data = newest.read_bytes()
+    newest.write_bytes(data[: len(data) // 2])
+
+    from pyrecover_tpu.utils.logging import init_logger
+
+    logger = init_logger()
+    logger.propagate = True
+    try:
+        with caplog.at_level(logging.INFO, logger="pyrecover_tpu"):
+            cfg2 = tiny_config(tmp_path, resume_from_checkpoint="latest")
+            _, end_step, _ = train(cfg2)
+    finally:
+        logger.propagate = False
+    assert end_step == 8
+    msgs = [r.getMessage() for r in caplog.records]
+    assert any(
+        ("failed integrity pre-check" in m or "failed to restore" in m)
+        and "ckpt_8_final" in m
+        for m in msgs
+    )
+    assert any("Resumed from" in m and "ckpt_4" in m for m in msgs)
+
+    # explicit path → hard failure, no silent substitution (the fallback
+    # run just re-saved a GOOD ckpt_8_final at completion; corrupt it again)
+    data = newest.read_bytes()
+    newest.write_bytes(data[: len(data) // 2])
+    with pytest.raises(Exception):
+        cfg3 = tiny_config(
+            tmp_path, resume_from_checkpoint=str(newest)
+        )
+        train(cfg3)
+
+    # wrong model config → CheckpointStructureError fails HARD even under
+    # 'latest' (every candidate would fail identically; a silent fresh
+    # start would let pruning destroy the intact checkpoints)
+    from pyrecover_tpu.checkpoint.vanilla import CheckpointStructureError
+
+    cfg4 = tiny_config(tmp_path, resume_from_checkpoint="latest")
+    cfg4.model = ModelConfig().tiny(max_seq_len=32, vocab_size=128,
+                                    n_layers=4)  # trained with 2 layers
+    cfg4.__post_init__()
+    with pytest.raises(CheckpointStructureError):
+        train(cfg4)
+
+    # ALL candidates corrupt → refuse to start fresh over them
+    for p in exp.glob("ckpt_*.ckpt"):
+        d = p.read_bytes()
+        p.write_bytes(d[: max(len(d) // 2, 1)])
+    with pytest.raises(RuntimeError, match="refusing"):
+        train(tiny_config(tmp_path, resume_from_checkpoint="latest"))
+
+
 def test_done_marker_on_completion(tmp_path):
     cfg = tiny_config(tmp_path, training_steps=2, checkpoint_frequency=-1)
     _, _, stopped = train(cfg)
