@@ -133,6 +133,10 @@ def main():
                          "outputs (skips recomputing the attention sublayer)")
     ap.add_argument("--flash-block-q", type=int, default=1024)
     ap.add_argument("--flash-block-kv", type=int, default=1024)
+    ap.add_argument("--moe-dispatch", default="auto",
+                    choices=["auto", "grouped", "einsum", "scatter"],
+                    help="MoE dispatch backend (A/B the grouped ragged-GEMM "
+                         "path against the r3 einsum/scatter backends)")
     args = ap.parse_args()
 
     n_devices = jax.device_count()
@@ -162,6 +166,7 @@ def main():
     model_cfg = dataclasses.replace(
         model_cfg, flash_block_q=args.flash_block_q,
         flash_block_kv=args.flash_block_kv, remat_policy=args.remat_policy,
+        moe_dispatch=args.moe_dispatch,
     )
     train_cfg = TrainConfig(
         sequence_length=args.seq_len,
