@@ -343,6 +343,43 @@ def read_ckpt_raw(path, *, check_version=True):
     return _decode_ckpt_bytes(data, check_version=check_version)
 
 
+def diagnose_ckpt_bytes(data):
+    """Best-effort forensic walk of a (possibly corrupt) checkpoint buffer
+    — kept NEXT TO the real decoder so the format knowledge lives in one
+    module. Never raises. Returns a dict:
+    ``{"magic_ok", "meta" (dict or None), "meta_error", "intact_leaves",
+    "break_offset"}``."""
+    out = {"magic_ok": data[: len(MAGIC)] == MAGIC, "meta": None,
+           "meta_error": None, "intact_leaves": 0, "break_offset": None}
+    if not out["magic_ok"]:
+        return out
+    off = len(MAGIC)
+    try:
+        mlen = int.from_bytes(data[off : off + 8], "little")
+        out["meta"] = json.loads(data[off + 8 : off + 8 + mlen].decode())
+        off = off + 8 + mlen
+    except Exception as e:
+        out["meta_error"] = f"{type(e).__name__}: {e}"
+        return out
+    for lm in out["meta"].get("leaves", []):
+        try:
+            if off + 8 > len(data):
+                break
+            n = int.from_bytes(data[off : off + 8], "little")
+            count = (
+                int(np.prod(lm["shape"], dtype=np.int64)) if lm["shape"] else 1
+            )
+            expect = count * _dtype_from_str(lm["dtype"]).itemsize
+            if n != expect or off + 8 + n > len(data):
+                break
+            out["intact_leaves"] += 1
+            off += 8 + n
+        except Exception:
+            break  # garbled leaf metadata: stop the walk here
+    out["break_offset"] = off
+    return out
+
+
 def _decode_ckpt_bytes(data, *, check_version=True):
     """Decode an in-memory checkpoint buffer (both formats); see
     ``read_ckpt_raw``."""

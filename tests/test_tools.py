@@ -143,3 +143,23 @@ def test_generate_from_checkpoint(tmp_path):
     out3 = subprocess.run(args + ["--temperature", "1.0"], capture_output=True,
                           text=True, timeout=300, env=env)
     assert out3.returncode == 0, out3.stderr[-2000:]
+
+
+def test_inspect_diagnoses_corrupt_checkpoint(tmp_path, capsys):
+    """tools/inspect_checkpoint.py is where the trainer's corrupt-
+    checkpoint errors send people: on a truncated file it must print
+    forensics (checksum verdict, intact frame count) and exit 1, not
+    crash with a decode traceback."""
+    state = make_state()
+    path = tmp_path / "ckpt_5.ckpt"
+    save_ckpt_vanilla(path, state, {"consumed": 5}, verify=True,
+                      extra_meta={"step": 5})
+    data = path.read_bytes()
+    path.write_bytes(data[: len(data) // 2])
+
+    rc = inspect_main([str(path)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "CORRUPT" in out
+    assert "MISMATCH" in out
+    assert "intact leaf frames" in out

@@ -23,7 +23,10 @@ def human(n):
 def inspect_vanilla(path, show_leaves):
     from pyrecover_tpu.checkpoint.vanilla import read_ckpt_raw
 
-    meta, paths, leaves = read_ckpt_raw(path, check_version=False)
+    try:
+        meta, paths, leaves = read_ckpt_raw(path, check_version=False)
+    except Exception as e:
+        return _diagnose_corrupt_vanilla(Path(path), e)
     print(f"format: vanilla single-file (v{meta['format']})")
     for k in ("step", "epoch"):
         if k in meta:
@@ -35,6 +38,75 @@ def inspect_vanilla(path, show_leaves):
     if show_leaves:
         for p, x in zip(paths, leaves):
             print(f"  {p}: {x.dtype} {tuple(x.shape)} {human(x.nbytes)}")
+    return 0
+
+
+def _diagnose_corrupt_vanilla(path, err):
+    """Best-effort forensics for a file that does not fully decode — this
+    tool is where the trainer's corrupt-checkpoint errors send people, so
+    it must explain the damage, not crash on it. One file read; the
+    checksum is computed over the in-memory buffer; the container walk is
+    ``diagnose_ckpt_bytes`` (lives next to the real decoder, so format
+    knowledge stays in one module)."""
+    print(f"CORRUPT: checkpoint does not fully decode ({type(err).__name__}: {err})")
+    try:
+        import hashlib
+
+        from pyrecover_tpu.checkpoint import native_io
+        from pyrecover_tpu.checkpoint.vanilla import (
+            _sidecar,
+            diagnose_ckpt_bytes,
+        )
+        from pyrecover_tpu.utils import xxh
+
+        data = path.read_bytes()
+        print(f"file size: {human(len(data))}")
+        sidecar = _sidecar(path)
+        if sidecar.exists():
+            try:
+                expected = sidecar.read_text().strip()
+                algo, param, digest = expected.split(":", 2)
+                if algo == "xxh64tree":
+                    chunk = int(param)
+                    actual = (
+                        native_io.tree_hash(data, chunk=chunk)
+                        if native_io.available()
+                        else xxh.tree_hash_bytes(data, chunk)
+                    )
+                    ok = f"{actual:016x}" == digest
+                else:
+                    ok = hashlib.sha256(data).hexdigest() == digest
+                print(
+                    "checksum vs sidecar: "
+                    + ("OK (sidecar matches this content)" if ok
+                       else "MISMATCH (file truncated or bit-flipped after save)")
+                )
+            except Exception as e:
+                print(f"checksum vs sidecar: unreadable ({e})")
+        else:
+            print("checksum vs sidecar: no sidecar present")
+
+        d = diagnose_ckpt_bytes(data)
+        if not d["magic_ok"]:
+            print("v2 magic header missing — legacy v1 msgpack or not a "
+                  "pyrecover checkpoint")
+            return 1
+        if d["meta"] is None:
+            print(f"meta header unreadable ({d['meta_error']}); nothing "
+                  "else recoverable")
+            return 1
+        print(f"meta header intact: step={d['meta'].get('step')} "
+              f"leaves={d['meta'].get('num_leaves')}")
+        print(
+            f"intact leaf frames: {d['intact_leaves']}/"
+            f"{d['meta'].get('num_leaves')} (container breaks at byte "
+            f"{d['break_offset']} of {len(data)})"
+        )
+        print("the trainer's 'latest' resume falls back past this file "
+              "automatically; delete it (and its sidecar) once diagnosed")
+    except Exception as e:  # forensics must never crash like the decode did
+        print(f"(forensics incomplete: {type(e).__name__}: {e})")
+    return 1
 
 
 def inspect_sharded(path, show_leaves):
@@ -80,6 +152,12 @@ def inspect_sharded(path, show_leaves):
 
 
 def main(argv=None):
+    # behave like a unix tool when piped into head & co.
+    import contextlib
+    import signal as _signal
+
+    with contextlib.suppress(Exception):
+        _signal.signal(_signal.SIGPIPE, _signal.SIG_DFL)
     ap = argparse.ArgumentParser()
     ap.add_argument("checkpoint")
     ap.add_argument("--leaves", action="store_true", help="list every leaf")
@@ -90,9 +168,8 @@ def main(argv=None):
         return 2
     if p.is_dir():
         inspect_sharded(p, args.leaves)
-    else:
-        inspect_vanilla(p, args.leaves)
-    return 0
+        return 0
+    return inspect_vanilla(p, args.leaves)
 
 
 if __name__ == "__main__":
