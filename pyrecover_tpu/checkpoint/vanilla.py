@@ -343,6 +343,31 @@ def read_ckpt_raw(path, *, check_version=True):
     return _decode_ckpt_bytes(data, check_version=check_version)
 
 
+def _leaf_nbytes(lm):
+    """Byte count a leaf's frame must have, from its meta entry."""
+    count = int(np.prod(lm["shape"], dtype=np.int64)) if lm["shape"] else 1
+    return count * _dtype_from_str(lm["dtype"]).itemsize
+
+
+def _check_leaf_frame(i, lm, n, end, size):
+    """Validate one v2 leaf frame — the single source of truth shared by
+    the decoder and the structural walk. A corrupted length prefix (with
+    enough trailing bytes) would otherwise silently desynchronize every
+    subsequent leaf into garbage, so every load path fails loudly here.
+    ``end`` is the frame's end offset, ``size`` the total byte count."""
+    expect = _leaf_nbytes(lm)
+    if n != expect:
+        raise ValueError(
+            f"leaf {i}: length prefix {n} != {expect} expected from meta "
+            f"(dtype {lm['dtype']}, shape {lm['shape']}) — corrupt frame"
+        )
+    if end > size:
+        raise ValueError(
+            f"leaf {i}: frame extends past end of file ({end} > {size}) "
+            "— truncated checkpoint"
+        )
+
+
 def diagnose_ckpt_bytes(data):
     """Best-effort forensic walk of a (possibly corrupt) checkpoint buffer
     — kept NEXT TO the real decoder so the format knowledge lives in one
@@ -366,11 +391,7 @@ def diagnose_ckpt_bytes(data):
             if off + 8 > len(data):
                 break
             n = int.from_bytes(data[off : off + 8], "little")
-            count = (
-                int(np.prod(lm["shape"], dtype=np.int64)) if lm["shape"] else 1
-            )
-            expect = count * _dtype_from_str(lm["dtype"]).itemsize
-            if n != expect or off + 8 + n > len(data):
+            if n != _leaf_nbytes(lm) or off + 8 + n > len(data):
                 break
             out["intact_leaves"] += 1
             off += 8 + n
@@ -392,9 +413,10 @@ def _decode_ckpt_bytes(data, *, check_version=True):
         if check_version and meta["format"] not in SUPPORTED_FORMATS:
             raise ValueError(f"Unsupported checkpoint format {meta['format']}")
         leaves = []
-        for lm in meta["leaves"]:
+        for i, lm in enumerate(meta["leaves"]):
             n = int.from_bytes(data[off : off + 8], "little")
             off += 8
+            _check_leaf_frame(i, lm, n, off + n, len(data))
             dt = _dtype_from_str(lm["dtype"])
             count = int(np.prod(lm["shape"], dtype=np.int64)) if lm["shape"] else 1
             arr = np.frombuffer(data, dtype=dt, count=count, offset=off)
@@ -412,41 +434,53 @@ def _decode_ckpt_bytes(data, *, check_version=True):
     return meta, paths, leaves
 
 
-def precheck_ckpt_vanilla(path, *, verify=False):
-    """Host-LOCAL integrity check (no collectives): one read of the file,
-    checksummed in memory against the sidecar whenever one exists (or
-    required when ``verify`` demands it), and the v2 container's frame
-    structure walked on the same buffer (zero-copy views, no second
-    read). Returns (ok, reason). Used by the latest-resume fallback to
-    agree on a candidate on host 0 BEFORE every host enters the
-    collective load (a per-host exception inside the load would
-    desynchronize the barrier protocol on pods)."""
-    from pyrecover_tpu.utils import xxh
+def _walk_ckpt_frames(path):
+    """Seek-based structural walk of a v2 container: reads only the magic,
+    the meta header, and each leaf's 8-byte length prefix — O(meta) bytes
+    and O(1) RAM, no whole-file buffer. Raises on any structural
+    inconsistency (bad magic handled by the v1 fallback, bad length
+    prefix, truncation). Legacy v1 files have no frame structure to walk
+    without a full msgpack decode, so they fall back to a full read."""
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        if f.read(len(MAGIC)) != MAGIC:
+            f.seek(0)
+            _decode_ckpt_bytes(f.read())  # legacy v1: full decode
+            return
+        mlen = int.from_bytes(f.read(8), "little")
+        meta = json.loads(f.read(mlen).decode())
+        if meta["format"] not in SUPPORTED_FORMATS:
+            raise ValueError(f"Unsupported checkpoint format {meta['format']}")
+        off = len(MAGIC) + 8 + mlen
+        for i, lm in enumerate(meta["leaves"]):
+            prefix = f.read(8)
+            if len(prefix) < 8:
+                raise ValueError(f"leaf {i}: truncated length prefix")
+            n = int.from_bytes(prefix, "little")
+            off += 8 + n
+            _check_leaf_frame(i, lm, n, off, size)
+            f.seek(off)
 
+
+def precheck_ckpt_vanilla(path, *, verify=False):
+    """Host-LOCAL integrity check (no collectives): the sidecar checksum is
+    verified with a CHUNKED streaming read (O(chunk) host RAM — at the 8B
+    flagship a whole-file buffer here would undo the streaming-save RAM
+    work on the restore side), and the v2 container's frame structure is
+    walked with header-only seeks. Returns (ok, reason). Used by the
+    latest-resume fallback to agree on a candidate on host 0 BEFORE every
+    host enters the collective load (a per-host exception inside the load
+    would desynchronize the barrier protocol on pods)."""
     path = Path(path)
     try:
-        data = path.read_bytes()
         sidecar = _sidecar(path)
         if sidecar.exists():
             expected = sidecar.read_text().strip()
-            algo, param, digest = expected.split(":", 2)
-            if algo == "xxh64tree":
-                from pyrecover_tpu.checkpoint import native_io
-
-                chunk = int(param)
-                actual = (
-                    native_io.tree_hash(data, chunk=chunk)
-                    if native_io.available()
-                    else xxh.tree_hash_bytes(data, chunk)
-                )
-                ok = f"{actual:016x}" == digest
-            else:
-                ok = hashlib.sha256(data).hexdigest() == digest
-            if not ok:
+            if not verify_checksum(path, expected):
                 return False, "checksum mismatch"
         elif verify:
             return False, f"checksum sidecar missing: {sidecar}"
-        _decode_ckpt_bytes(data)  # frame walk on the same buffer
+        _walk_ckpt_frames(path)
     except Exception as e:
         return False, f"{type(e).__name__}: {e}"
     return True, ""

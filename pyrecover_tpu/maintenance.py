@@ -141,6 +141,7 @@ class MaintenanceEventWatcher:
 
     def _run(self):
         errors = 0
+        ever_ok = False  # has ANY request ever succeeded?
         etag = None
         while not self._stop.is_set() and self.event_seen is None:
             try:
@@ -148,6 +149,7 @@ class MaintenanceEventWatcher:
                 # resource reclaims flip it without a maintenance-event
                 val, _ = self._get("instance/preempted", timeout=10)
                 errors = 0  # any successful request proves the server lives
+                ever_ok = True
                 if val.upper() == "TRUE":
                     self._fire("instance/preempted=TRUE")
                     return
@@ -162,13 +164,28 @@ class MaintenanceEventWatcher:
                     self._fire(f"instance/maintenance-event={val}")
                     return
             except (urllib.error.URLError, OSError, ValueError):
-                # no metadata server (not on GCE) or a transient failure
                 errors += 1
-                if errors >= self.max_consecutive_errors:
+                if not ever_ok:
+                    # the server was NEVER reachable: not on GCE — retire
+                    # quietly after a few tries, no thread left spinning
+                    if errors >= self.max_consecutive_errors:
+                        log_host0(
+                            "metadata server unreachable after %d attempts; "
+                            "maintenance-event watcher retiring (SIGTERM/"
+                            "notice-file preemption signals remain active)",
+                            errors,
+                        )
+                        return
+                elif errors == self.max_consecutive_errors:
+                    # WAS healthy, now erroring: a network blip mid-job must
+                    # not silently disable maintenance detection for the
+                    # rest of the run — keep retrying with capped backoff
                     log_host0(
-                        "metadata server unreachable after %d attempts; "
-                        "maintenance-event watcher retiring (SIGTERM/notice-"
-                        "file preemption signals remain active)", errors,
+                        "metadata server was healthy but has failed %d "
+                        "consecutive requests; retrying with capped backoff "
+                        "(maintenance-event detection degraded until it "
+                        "recovers)", errors, level=30,  # WARNING
                     )
-                    return
-                self._stop.wait(min(2.0**errors, self.poll_timeout_s))
+                # backoff ceiling stays poll_timeout_s (docstring contract):
+                # the blind window must remain inside GCE's ~30 s spot grace
+                self._stop.wait(min(2.0 ** min(errors, 6), self.poll_timeout_s))

@@ -413,42 +413,17 @@ def train(config: TrainConfig):
         prefetch=2, num_workers=4,
     ).start()
 
-    step_fn = make_train_step(
-        model_config, optimizer, loss_chunk_size=config.loss_chunk_size,
-        grad_accumulation_steps=config.grad_accumulation_steps,
-    )
-    # MFU/TFLOPs use the reference's 6N convention: token embedding excluded
-    # (ref train.py:126-127), untied output projection kept.
-    meter = ThroughputMeter(
-        model_config,
-        get_num_params(state.params, exclude_embedding=True),
-        config.sequence_length,
-        jax.device_count(),
-    )
-    csv_logger = LossCSVLogger(exp_dir, config.experiment_name,
-                               enabled=config.log_loss_to_csv,
-                               resume_step=start_step)
-    run_eval = build_eval_runner(config, model_config, pad_token_id, mesh)
-    watcher = PreemptionWatcher(
-        enabled=config.timeaware_checkpointing,
-        default_iter_time=config.default_iter_time,
-        default_ckpt_time=config.default_ckpt_time,
-        job_end_time=config.job_end_time,
-        check_interval=config.preempt_check_interval,
-    ).install_signal_handler().start_maintenance_watcher()
-
-    # ---- hot loop (reference train.py:220-379) -----------------------------
-    # Device syncs (materializing the loss) and the cross-host stop broadcast
-    # run only on logging/preempt-check steps — every other step is pure
-    # async dispatch, so neither time-aware mode nor --log-loss-to-csv taxes
-    # the hot path. ``pending_tokens`` / ``pending_losses`` hold the per-step
-    # device scalars between syncs (tiny arrays; materialized in one batch at
-    # the next sync point — by then all but the newest are already computed).
+    # everything past loader.start() runs under try/finally: an exception
+    # anywhere below (setup included) must stop the prefetch threads and
+    # any in-flight background save — the daemon flag covers process exit,
+    # but long-lived callers (tests, the resilient-launcher loop) would
+    # otherwise leak threads and queued device batches per failed attempt
     step = start_step
     stopped_early = False
-    train_t0 = time.monotonic()
     profiling = False
-    pending_tokens = []
+    run_eval = None
+    watcher = None
+    csv_logger = None
     pending_losses = []  # (step, loss device scalar) for the CSV
 
     def flush_csv():
@@ -456,94 +431,150 @@ def train(config: TrainConfig):
             csv_logger.log(s_, float(l_))
         pending_losses.clear()
 
-    sync_t0 = time.monotonic()
-    steps_since_sync = 0
-    with jax.sharding.set_mesh(mesh):
-        while step < config.training_steps:
-            if config.profile and step == config.profile_step_start and not profiling:
-                jax.profiler.start_trace(config.profile_dir)
-                profiling = True
+    try:
+        step_fn = make_train_step(
+            model_config, optimizer, loss_chunk_size=config.loss_chunk_size,
+            grad_accumulation_steps=config.grad_accumulation_steps,
+        )
+        # MFU/TFLOPs use the reference's 6N convention: token embedding
+        # excluded (ref train.py:126-127), untied output projection kept.
+        meter = ThroughputMeter(
+            model_config,
+            get_num_params(state.params, exclude_embedding=True),
+            config.sequence_length,
+            jax.device_count(),
+        )
+        csv_logger = LossCSVLogger(exp_dir, config.experiment_name,
+                                   enabled=config.log_loss_to_csv,
+                                   resume_step=start_step)
+        run_eval = build_eval_runner(config, model_config, pad_token_id, mesh)
+        watcher = PreemptionWatcher(
+            enabled=config.timeaware_checkpointing,
+            default_iter_time=config.default_iter_time,
+            default_ckpt_time=config.default_ckpt_time,
+            job_end_time=config.job_end_time,
+            check_interval=config.preempt_check_interval,
+        ).install_signal_handler().start_maintenance_watcher()
 
-            epoch, batch = next(loader)
-            state, metrics = step_fn(state, batch)
-            step += 1
-            steps_since_sync += 1
-            pending_tokens.append(metrics["n_tokens"])
-            if csv_logger.enabled:
-                pending_losses.append((step, metrics["loss"]))
+        # ---- hot loop (reference train.py:220-379) -------------------------
+        # Device syncs (materializing the loss) and the cross-host stop
+        # broadcast run only on logging/preempt-check steps — every other
+        # step is pure async dispatch, so neither time-aware mode nor
+        # --log-loss-to-csv taxes the hot path. ``pending_tokens`` /
+        # ``pending_losses`` hold the per-step device scalars between syncs
+        # (tiny arrays; materialized in one batch at the next sync point —
+        # by then all but the newest are already computed).
+        train_t0 = time.monotonic()
+        pending_tokens = []
+        sync_t0 = time.monotonic()
+        steps_since_sync = 0
+        with jax.sharding.set_mesh(mesh):
+            while step < config.training_steps:
+                if (
+                    config.profile
+                    and step == config.profile_step_start
+                    and not profiling
+                ):
+                    jax.profiler.start_trace(config.profile_dir)
+                    profiling = True
 
-            check_preempt = watcher.is_check_step(step)
-            want_log = step % config.logging_frequency == 0
-            if want_log or check_preempt:
-                loss = float(metrics["loss"])  # device sync
-                for t in pending_tokens:
-                    meter.update(int(t), config.batch_size)
-                pending_tokens.clear()
-                flush_csv()
-                if want_log:
-                    meter.log(step, epoch, loss)
-                # honest per-step time: interval average between sync points
-                # (per-step wall time under async dispatch measures only the
-                # dispatch, except on sync steps where it spikes)
-                now = time.monotonic()
-                watcher.observe_iter((now - sync_t0) / steps_since_sync)
-                sync_t0 = now
-                steps_since_sync = 0
+                epoch, batch = next(loader)
+                state, metrics = step_fn(state, batch)
+                step += 1
+                steps_since_sync += 1
+                pending_tokens.append(metrics["n_tokens"])
+                if csv_logger.enabled:
+                    pending_losses.append((step, metrics["loss"]))
 
-            if config.profile and step == config.profile_step_end and profiling:
-                jax.profiler.stop_trace()
-                profiling = False
+                check_preempt = watcher.is_check_step(step)
+                want_log = step % config.logging_frequency == 0
+                if want_log or check_preempt:
+                    loss = float(metrics["loss"])  # device sync
+                    for t in pending_tokens:
+                        meter.update(int(t), config.batch_size)
+                    pending_tokens.clear()
+                    flush_csv()
+                    if want_log:
+                        meter.log(step, epoch, loss)
+                    # honest per-step time: interval average between sync
+                    # points (per-step wall time under async dispatch
+                    # measures only the dispatch, except on sync steps
+                    # where it spikes)
+                    now = time.monotonic()
+                    watcher.observe_iter((now - sync_t0) / steps_since_sync)
+                    sync_t0 = now
+                    steps_since_sync = 0
 
-            # held-out evaluation (beyond-parity)
-            if run_eval is not None and step % config.eval_frequency == 0:
-                eval_loss = run_eval(state)
-                log_host0("eval | step %d | loss %.4f", step, eval_loss)
-                # exclude eval wall time from iter-time learning AND the
-                # throughput window (else tok/s and MFU logs are understated)
-                sync_t0 = time.monotonic()
-                steps_since_sync = 0
-                meter.reset()
+                if config.profile and step == config.profile_step_end and profiling:
+                    jax.profiler.stop_trace()
+                    profiling = False
 
-            # periodic checkpoint (reference train.py:310-331)
-            if (
-                config.checkpoint_frequency > 0
-                and step % config.checkpoint_frequency == 0
-                and step < config.training_steps
-            ):
-                secs = save_ckpt(step)
-                totals.ckpt_save_s += secs
-                watcher.observe_ckpt(secs)
-                # don't attribute checkpoint time to iteration time
-                sync_t0 = time.monotonic()
-                steps_since_sync = 0
+                # held-out evaluation (beyond-parity)
+                if run_eval is not None and step % config.eval_frequency == 0:
+                    eval_loss = run_eval(state)
+                    log_host0("eval | step %d | loss %.4f", step, eval_loss)
+                    # exclude eval wall time from iter-time learning AND the
+                    # throughput window (else tok/s and MFU are understated)
+                    sync_t0 = time.monotonic()
+                    steps_since_sync = 0
+                    meter.reset()
 
-            # time-aware stop (reference train.py:223-232, 342-375); cheap
-            # host-local notice signals are observed every step, the
-            # deadline/broadcast decision only on check steps
-            if watcher.should_stop(step):
-                secs = save_ckpt(step, final=True)
-                totals.ckpt_save_s += secs
-                stopped_early = True
-                break
+                # periodic checkpoint (reference train.py:310-331)
+                if (
+                    config.checkpoint_frequency > 0
+                    and step % config.checkpoint_frequency == 0
+                    and step < config.training_steps
+                ):
+                    secs = save_ckpt(step)
+                    totals.ckpt_save_s += secs
+                    watcher.observe_ckpt(secs)
+                    # don't attribute checkpoint time to iteration time
+                    sync_t0 = time.monotonic()
+                    steps_since_sync = 0
 
-    if profiling:
-        jax.profiler.stop_trace()
-    totals.train_s = time.monotonic() - train_t0
+                # time-aware stop (reference train.py:223-232, 342-375);
+                # cheap host-local notice signals are observed every step,
+                # the deadline/broadcast decision only on check steps
+                if watcher.should_stop(step):
+                    secs = save_ckpt(step, final=True)
+                    totals.ckpt_save_s += secs
+                    stopped_early = True
+                    break
 
-    # final checkpoint at completion (so `latest` is always the end state)
-    if not stopped_early and config.checkpoint_frequency > 0:
-        secs = save_ckpt(step, final=True)
-        totals.ckpt_save_s += secs
+        totals.train_s = time.monotonic() - train_t0
 
-    loader.stop()
-    if run_eval is not None:
-        run_eval.loader.stop()
-    watcher.stop_maintenance_watcher()
-    flush_csv()  # losses buffered since the last sync point
-    csv_logger.close()
-    join_pending_saves()
-    if sharded_ckptr is not None:
-        sharded_ckptr.close()
+        # final checkpoint at completion (`latest` is always the end state)
+        if not stopped_early and config.checkpoint_frequency > 0:
+            secs = save_ckpt(step, final=True)
+            totals.ckpt_save_s += secs
+    finally:
+        unwinding = sys.exc_info()[0] is not None
+        if profiling:
+            jax.profiler.stop_trace()
+        loader.stop()
+        if run_eval is not None:
+            run_eval.loader.stop()
+        if watcher is not None:
+            watcher.stop_maintenance_watcher()
+        if csv_logger is not None:
+            try:
+                flush_csv()  # losses buffered since the last sync point
+            except Exception:
+                # the buffered device scalars may be poisoned by the very
+                # error being unwound — dropping them must not mask it
+                pending_losses.clear()
+            csv_logger.close()
+        try:
+            join_pending_saves()  # a failed background save must fail the run
+        except Exception:
+            if not unwinding:
+                raise
+            log_host0(
+                "in-flight background checkpoint save also failed during "
+                "error unwind", level=30,  # WARNING; the original error wins
+            )
+        if sharded_ckptr is not None:
+            sharded_ckptr.close()
     write_requeue_marker(exp_dir, done=not stopped_early)
     log_host0(
         "%s after step %d | %s",

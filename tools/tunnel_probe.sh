@@ -1,0 +1,18 @@
+#!/bin/bash
+# Poll the axon TPU relay tunnel until a device-init probe succeeds.
+# Exits 0 the moment jax.devices() returns a TPU; logs each attempt to
+# tools/tunnel_probe.log. Used during the build to detect the tunnel's
+# return so on-chip benchmarks (BENCH_r05) can run the moment it's back.
+LOG=/root/repo/tools/tunnel_probe.log
+: > "$LOG"
+while true; do
+  ts=$(date -u +%FT%TZ)
+  out=$(timeout 90 python -c "import jax; ds=jax.devices(); print(ds[0].platform, len(ds))" 2>&1 | tail -1)
+  rc=$?
+  echo "$ts rc=$rc out=$out" >> "$LOG"
+  if [ $rc -eq 0 ] && echo "$out" | grep -qi tpu; then
+    echo "$ts TUNNEL ALIVE" >> "$LOG"
+    exit 0
+  fi
+  sleep 600
+done
