@@ -8,6 +8,7 @@ from pyrecover_tpu.checkpoint.vanilla import load_ckpt_vanilla, save_ckpt_vanill
 from pyrecover_tpu.checkpoint.sharded import (
     ShardedCheckpointer,
     load_ckpt_sharded,
+    precheck_ckpt_sharded,
     save_ckpt_sharded,
 )
 
@@ -21,4 +22,5 @@ __all__ = [
     "ShardedCheckpointer",
     "save_ckpt_sharded",
     "load_ckpt_sharded",
+    "precheck_ckpt_sharded",
 ]

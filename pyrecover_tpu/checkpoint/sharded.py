@@ -15,6 +15,7 @@ A checkpoint directory holds two items: ``state`` (the sharded pytree) and
 reference's `metadata={epoch,step}` planner state (checkpoint.py:254-258).
 """
 
+import json
 import time
 from pathlib import Path
 
@@ -22,6 +23,7 @@ import jax
 import orbax.checkpoint as ocp
 
 from pyrecover_tpu.checkpoint.registry import prune_checkpoints
+from pyrecover_tpu.checkpoint.vanilla import CheckpointStructureError
 from pyrecover_tpu.utils.logging import log_host0
 
 
@@ -91,6 +93,82 @@ class ShardedCheckpointer:
 
     def __exit__(self, *exc):
         self.close()
+
+
+def precheck_ckpt_sharded(path, target_state=None):
+    """Host-LOCAL integrity pre-check of an Orbax checkpoint directory (no
+    collectives, no tensor reads) — the sharded engine's analogue of
+    ``precheck_ckpt_vanilla``, so the latest-resume fallback can walk past
+    a preemption-torn newest checkpoint on THIS engine too (a preemption
+    mid-async-save is precisely the sharded engine's use case; reference
+    recovery intent: checkpoint.py:371-404's latest discovery).
+
+    Checks, cheapest first:
+      * the directory exists and carries Orbax's commit marker
+        ``_CHECKPOINT_METADATA`` (written at finalize — a torn save that
+        never reached its atomic rename has no marker) and it parses;
+      * the ``meta`` item (sampler state / counters JSON) parses;
+      * the ``state`` item has its OCDBT manifest and pytree ``_METADATA``;
+      * the pytree metadata probe (structure + per-leaf shapes/dtypes, no
+        tensor data) succeeds.
+
+    Returns ``(ok, reason)``. When ``target_state`` is given and the
+    checkpoint's leaf count or shape multiset doesn't fit it, raises
+    ``CheckpointStructureError`` instead of returning False: a wrong model
+    config fails on EVERY candidate, and silently walking back would
+    restart the run from an old step (or step 0) with the wrong model.
+
+    Tensor DATA corruption inside ``state/d/`` is out of scope (that would
+    be a full read, not a pre-check); it surfaces as a restore exception,
+    which the single-process fallback path also survives.
+    """
+    path = Path(path)
+    try:
+        if not path.is_dir():
+            return False, "not a directory"
+        commit = path / "_CHECKPOINT_METADATA"
+        if not commit.exists():
+            return False, "missing commit marker _CHECKPOINT_METADATA (torn save?)"
+        json.loads(commit.read_text())
+        meta_file = path / "meta" / "metadata"
+        if not meta_file.exists():
+            return False, "missing meta item"
+        json.loads(meta_file.read_text())
+        state_dir = path / "state"
+        manifest = state_dir / "manifest.ocdbt"
+        if not manifest.exists() or manifest.stat().st_size == 0:
+            return False, "missing/empty OCDBT manifest"
+        tree_meta = state_dir / "_METADATA"
+        if not tree_meta.exists():
+            return False, "missing pytree _METADATA"
+        # the metadata probe below parses _METADATA itself; malformed JSON
+        # surfaces there
+        md = ocp.PyTreeCheckpointHandler().metadata(state_dir).tree
+        ck_shapes = sorted(
+            tuple(x.shape)
+            for x in jax.tree_util.tree_leaves(
+                md, is_leaf=lambda x: hasattr(x, "shape") and hasattr(x, "dtype")
+            )
+        )
+    except Exception as e:
+        return False, f"{type(e).__name__}: {e}"
+    if target_state is not None:
+        tgt_shapes = sorted(
+            tuple(x.shape) for x in jax.tree_util.tree_leaves(target_state)
+        )
+        if ck_shapes != tgt_shapes:
+            from collections import Counter
+
+            ck_c, tgt_c = Counter(ck_shapes), Counter(tgt_shapes)
+            only_ck = list((ck_c - tgt_c).elements())[:4]
+            only_tgt = list((tgt_c - ck_c).elements())[:4]
+            raise CheckpointStructureError(
+                f"checkpoint {path.name} does not fit the configured model: "
+                f"{len(ck_shapes)} leaves vs {len(tgt_shapes)}; shapes only "
+                f"in checkpoint {only_ck}, only in model {only_tgt} — wrong "
+                "model config, not corruption"
+            )
+    return True, ""
 
 
 def save_ckpt_sharded(path, state, sampler_state=None, *, max_keep=None,

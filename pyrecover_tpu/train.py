@@ -214,6 +214,125 @@ def build_eval_runner(config, model_config, pad_token_id, mesh):
     return run_eval
 
 
+def _resume(config, exp_dir, state, sampler, sharded_ckptr, totals):
+    """Resume from ``config.resume_from_checkpoint`` (reference
+    train.py:195-212). Returns ``(start_step, state)``.
+
+    "latest" walks candidates newest→oldest and FALLS BACK past a
+    corrupt/truncated/torn checkpoint — exactly what a crash during or
+    after the newest save leaves behind, on EITHER engine (vanilla
+    single-file or sharded/Orbax); the integrity pre-check catches it and
+    the fallback turns it into a recovery instead of a dead job.
+    Multi-host safety: corruption is judged by a host-LOCAL pre-check on
+    host 0 and the verdict broadcast, so every host enters the collective
+    load for the SAME candidate (a per-host exception inside the load
+    would desynchronize the barrier protocol). A structural mismatch
+    (CheckpointStructureError: wrong leaf count/shapes = wrong model
+    config) fails hard — every candidate would fail identically and a
+    silent fresh start would let retention pruning destroy the intact
+    checkpoints it skipped. An explicitly named checkpoint also fails
+    hard: the user asked for THAT file.
+    """
+    from pyrecover_tpu.checkpoint import precheck_ckpt_sharded
+    from pyrecover_tpu.checkpoint.vanilla import (
+        CheckpointStructureError,
+        precheck_ckpt_vanilla,
+    )
+    from pyrecover_tpu.parallel.mesh import broadcast_host0_scalar
+
+    t0 = time.monotonic()
+    target = config.resume_from_checkpoint
+    explicit = target != "latest"
+    if explicit:
+        candidates = [target]
+    else:
+        candidates = list_checkpoints(
+            exp_dir, sharded=config.sharded_checkpoint
+        )[::-1]
+        if not candidates:
+            log_host0("No checkpoint found in %s; starting fresh", exp_dir)
+            return 0, state
+    for cand in candidates:
+        prechecked = False
+        if not explicit:
+            # host-0 verdict, agreed everywhere, BEFORE any collective:
+            # 1 = ok, 0 = corrupt (fall back), 2 = structure mismatch
+            # (wrong model config — fatal on EVERY candidate, raised on
+            # all hosts so nobody is left waiting in a collective)
+            verdict, reason = 1, ""
+            if jax.process_index() == 0:
+                try:
+                    if config.sharded_checkpoint:
+                        ok, reason = precheck_ckpt_sharded(cand, state)
+                    else:
+                        ok, reason = precheck_ckpt_vanilla(
+                            cand, verify=config.verify_checkpoints
+                        )
+                    verdict = 1 if ok else 0
+                except CheckpointStructureError as e:
+                    verdict, reason = 2, str(e)
+            verdict = int(broadcast_host0_scalar(verdict))
+            if verdict == 2:
+                raise CheckpointStructureError(
+                    f"checkpoint {cand} does not fit the configured "
+                    f"model{': ' + reason if reason else ''}"
+                )
+            if verdict == 0:
+                log_host0(
+                    "Checkpoint %s failed integrity pre-check (%s); "
+                    "falling back to the previous one", cand, reason,
+                    level=30,  # WARNING
+                )
+                continue
+            prechecked = True
+        try:
+            if config.sharded_checkpoint:
+                state, sampler_meta, meta = sharded_ckptr.restore(cand, state)
+            else:
+                # single-process: the pre-check just checksummed the same
+                # bytes — don't pay a second verification pass (multi-host
+                # keeps the in-load verify: hosts != 0 read the file
+                # themselves)
+                verify = config.verify_checkpoints and not (
+                    prechecked and jax.process_count() == 1
+                )
+                state, sampler_meta, meta = load_ckpt_vanilla(
+                    cand, state, verify=verify
+                )
+        except Exception as e:
+            if (
+                explicit
+                or isinstance(e, CheckpointStructureError)
+                or jax.process_count() > 1
+            ):
+                # explicit request, wrong-model-config, or a pod (where a
+                # mid-load divergence cannot be recovered safely —
+                # corruption the precheck can see never reaches here on a
+                # pod; only tensor-data-level damage does)
+                raise
+            log_host0(
+                "Checkpoint %s failed to restore (%s: %s); falling back "
+                "to the previous one", cand, type(e).__name__, e,
+                level=30,  # WARNING
+            )
+            continue
+        start_step = int(meta.get("step", int(np.asarray(state.step))))
+        sampler.seek(sampler_meta.get("consumed", start_step))
+        totals.ckpt_load_s += time.monotonic() - t0
+        log_host0(
+            "Resumed from %s at step %d (%.2f s)", cand, start_step,
+            totals.ckpt_load_s,
+        )
+        return start_step, state
+    # refuse to run: a fresh start would save new checkpoints and retention
+    # pruning would then delete the (possibly still recoverable) old ones
+    raise RuntimeError(
+        f"every checkpoint in {exp_dir} failed to restore; refusing to "
+        "start fresh over existing checkpoints — inspect them with "
+        "tools/inspect_checkpoint.py or move them aside"
+    )
+
+
 def train(config: TrainConfig):
     init_logger()
     # --distributed makes a failed/absent rendezvous fatal (reference
@@ -307,107 +426,21 @@ def train(config: TrainConfig):
         bpe = sampler.batches_per_epoch
         return int(step) // bpe if bpe else 0
 
-    # ---- resume (reference train.py:195-212) -------------------------------
-    # "latest" walks candidates newest→oldest and FALLS BACK past a
-    # corrupt/truncated/torn file — exactly what a crash during or after
-    # the newest save leaves behind; the checksum/decode pre-check catches
-    # it and the fallback turns it into a recovery instead of a dead job.
-    # Multi-host safety: corruption is judged by a host-LOCAL pre-check on
-    # host 0 and the verdict broadcast, so every host enters the collective
-    # load for the SAME candidate (a per-host exception inside the load
-    # would desynchronize the barrier protocol). A structural mismatch
-    # (CheckpointStructureError: wrong leaf count/shapes = wrong model
-    # config) fails hard — every candidate would fail identically and a
-    # silent fresh start would let retention pruning destroy the intact
-    # checkpoints it skipped. An explicitly named checkpoint also fails
-    # hard: the user asked for THAT file.
+    # ---- resume (reference train.py:195-212; policy in _resume) ------------
     start_step = 0
     if config.resume_from_checkpoint:
-        from pyrecover_tpu.checkpoint.vanilla import (
-            CheckpointStructureError,
-            precheck_ckpt_vanilla,
-        )
-        from pyrecover_tpu.parallel.mesh import broadcast_host0_scalar
-
-        t0 = time.monotonic()
-        target = config.resume_from_checkpoint
-        explicit = target != "latest"
-        if explicit:
-            candidates = [target]
-        else:
-            candidates = list_checkpoints(
-                exp_dir, sharded=config.sharded_checkpoint
-            )[::-1]
-            if not candidates:
-                log_host0("No checkpoint found in %s; starting fresh", exp_dir)
-        restored = not candidates
-        for cand in candidates:
-            prechecked = False
-            if not explicit and not config.sharded_checkpoint:
-                # host-0 verdict, agreed everywhere, BEFORE any collective
-                ok, reason = True, ""
-                if jax.process_index() == 0:
-                    ok, reason = precheck_ckpt_vanilla(
-                        cand, verify=config.verify_checkpoints
-                    )
-                if not bool(broadcast_host0_scalar(ok)):
-                    log_host0(
-                        "Checkpoint %s failed integrity pre-check (%s); "
-                        "falling back to the previous one", cand, reason,
-                        level=30,  # WARNING
-                    )
-                    continue
-                prechecked = True
-            try:
-                if config.sharded_checkpoint:
-                    state, sampler_meta, meta = sharded_ckptr.restore(
-                        cand, state
-                    )
-                else:
-                    # single-process: the pre-check just checksummed the
-                    # same bytes — don't pay a second verification pass
-                    # (multi-host keeps the in-load verify: hosts != 0
-                    # read the file themselves)
-                    verify = config.verify_checkpoints and not (
-                        prechecked and jax.process_count() == 1
-                    )
-                    state, sampler_meta, meta = load_ckpt_vanilla(
-                        cand, state, verify=verify
-                    )
-            except Exception as e:
-                if (
-                    explicit
-                    or isinstance(e, CheckpointStructureError)
-                    or jax.process_count() > 1
-                ):
-                    # explicit request, wrong-model-config, or a pod (where
-                    # a mid-load divergence cannot be recovered safely)
-                    raise
-                log_host0(
-                    "Checkpoint %s failed to restore (%s: %s); falling back "
-                    "to the previous one", cand, type(e).__name__, e,
-                    level=30,  # WARNING
-                )
-                continue
-            start_step = int(meta.get("step", int(np.asarray(state.step))))
-            sampler.seek(sampler_meta.get("consumed", start_step))
-            totals.ckpt_load_s += time.monotonic() - t0
-            log_host0(
-                "Resumed from %s at step %d (%.2f s)", cand, start_step,
-                totals.ckpt_load_s,
+        try:
+            start_step, state = _resume(
+                config, exp_dir, state, sampler, sharded_ckptr, totals
             )
-            restored = True
-            break
-        if not restored:
-            # refuse to run: a fresh start would save new checkpoints and
-            # retention pruning would then delete the (possibly still
-            # recoverable) old ones
-            raise RuntimeError(
-                f"every checkpoint in {exp_dir} failed to restore; refusing "
-                "to start fresh over existing checkpoints — inspect them "
-                "with tools/inspect_checkpoint.py or move them aside"
-            )
-
+        except BaseException:
+            # the teardown try/finally only starts after loader.start();
+            # a failed resume (wrong model config, every-candidate-corrupt)
+            # must not leak the async checkpointer's thread machinery in
+            # long-lived callers
+            if sharded_ckptr is not None:
+                sharded_ckptr.close()
+            raise
     loader = DataLoader(
         dataset, sampler, pad_token_id=pad_token_id, mesh=mesh,
         prefetch=2, num_workers=4,

@@ -202,6 +202,98 @@ def test_resume_falls_back_past_corrupt_checkpoint(tmp_path, caplog):
         train(tiny_config(tmp_path, resume_from_checkpoint="latest"))
 
 
+def test_sharded_resume_falls_back_past_corrupt_checkpoint(tmp_path, caplog):
+    """Recovery parity between the engines: the SHARDED (Orbax) path must
+    also walk back past a torn/corrupt newest checkpoint under 'latest' —
+    a preemption mid-async-save is precisely this engine's use case.
+    Round-4 verdict missing #2 (the sharded path used to fail hard on any
+    restore exception)."""
+    import logging
+    import shutil
+
+    cfg = tiny_config(tmp_path, training_steps=8, checkpoint_frequency=4,
+                      sharded_checkpoint=True)
+    train(cfg)
+    exp = tmp_path / "e2e"
+    newest = exp / "ckpt_8_final"
+    older = exp / "ckpt_4"
+    assert newest.is_dir() and older.is_dir()
+    # tear the newest like an interrupted finalize: no commit marker
+    (newest / "_CHECKPOINT_METADATA").unlink()
+
+    from pyrecover_tpu.utils.logging import init_logger
+
+    logger = init_logger()
+    logger.propagate = True
+    try:
+        with caplog.at_level(logging.INFO, logger="pyrecover_tpu"):
+            cfg2 = tiny_config(tmp_path, resume_from_checkpoint="latest",
+                               sharded_checkpoint=True)
+            _, end_step, _ = train(cfg2)
+    finally:
+        logger.propagate = False
+    assert end_step == 8
+    msgs = [r.getMessage() for r in caplog.records]
+    assert any(
+        "failed integrity pre-check" in m and "ckpt_8_final" in m for m in msgs
+    )
+    assert any("Resumed from" in m and "ckpt_4" in m for m in msgs)
+
+    # the fallback run re-saved a good ckpt_8_final; now corrupt the pytree
+    # metadata (structural damage inside the state item)
+    (newest / "state" / "_METADATA").write_text("{ not json")
+    caplog.clear()
+    logger.propagate = True
+    try:
+        with caplog.at_level(logging.INFO, logger="pyrecover_tpu"):
+            cfg3 = tiny_config(tmp_path, resume_from_checkpoint="latest",
+                               sharded_checkpoint=True)
+            _, end_step, _ = train(cfg3)
+    finally:
+        logger.propagate = False
+    assert end_step == 8
+    assert any(
+        "failed integrity pre-check" in m and "ckpt_8_final" in m
+        for m in (r.getMessage() for r in caplog.records)
+    )
+
+    # tensor-data damage the cheap precheck can't see: the restore
+    # exception path must also fall back (single-process)
+    for f in (newest / "state" / "d").rglob("*"):
+        if f.is_file():
+            f.write_bytes(f.read_bytes()[: max(f.stat().st_size // 2, 1)])
+    cfg4 = tiny_config(tmp_path, resume_from_checkpoint="latest",
+                       sharded_checkpoint=True)
+    _, end_step, _ = train(cfg4)
+    assert end_step == 8
+
+    # explicit path → hard failure, no silent substitution
+    shutil.rmtree(newest / "state")
+    with pytest.raises(Exception):
+        train(tiny_config(tmp_path, resume_from_checkpoint=str(newest),
+                          sharded_checkpoint=True))
+
+    # wrong model config → CheckpointStructureError fails HARD under
+    # 'latest' (host-0 verdict code 2, raised on every host)
+    from pyrecover_tpu.checkpoint.vanilla import CheckpointStructureError
+
+    cfg5 = tiny_config(tmp_path, resume_from_checkpoint="latest",
+                       sharded_checkpoint=True)
+    cfg5.model = ModelConfig().tiny(max_seq_len=32, vocab_size=128,
+                                    n_layers=4)  # trained with 2 layers
+    cfg5.__post_init__()
+    with pytest.raises(CheckpointStructureError):
+        train(cfg5)
+
+    # ALL candidates corrupt → refuse to start fresh over them
+    for p in exp.iterdir():
+        if p.is_dir() and (p / "_CHECKPOINT_METADATA").exists():
+            (p / "_CHECKPOINT_METADATA").unlink()
+    with pytest.raises(RuntimeError, match="refusing"):
+        train(tiny_config(tmp_path, resume_from_checkpoint="latest",
+                          sharded_checkpoint=True))
+
+
 def test_done_marker_on_completion(tmp_path):
     cfg = tiny_config(tmp_path, training_steps=2, checkpoint_frequency=-1)
     _, _, stopped = train(cfg)
