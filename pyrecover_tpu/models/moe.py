@@ -27,7 +27,7 @@ construction — rank-and-scatter dispatch over static shapes:
 Top-k routing renormalizes the selected gate probabilities (Mixtral-style);
 the aux loss is the Switch load-balance loss ``E · Σ_e f_e·p_e`` per row.
 
-Three dispatch backends share these semantics (pinned equal by tests):
+Four dispatch backends share these semantics (pinned equal by tests):
 
   * ``_moe_ffn_grouped`` — the MXU path: each row's (token, slot) picks are
     sorted by expert and the expert FFNs run as ragged grouped matmuls
@@ -35,8 +35,14 @@ Three dispatch backends share these semantics (pinned equal by tests):
     capacity-padded slot tensor, no scatter serialization — the MXU sees
     one dense GEMM per expert sized by its actual load. Default wherever
     the expert axis is unsharded.
-  * ``_moe_ffn_impl`` (rank-and-scatter) — the EP path: static (B,E,C,D)
-    dispatch whose ``expert``-axis constrain turns into all-to-alls.
+  * ``_moe_ffn_grouped_ep`` — the MXU path composed with expert sharding:
+    an explicitly-SPMD shard_map where each expert shard ragged-GEMMs only
+    its local experts' picks (static bound E_loc·C rows) and one psum over
+    (expert, tensor) plays both the combine exchange and the row-parallel
+    reduction. Selected by ``moe_dispatch='grouped'`` with ep > 1.
+  * ``_moe_ffn_impl`` (rank-and-scatter) — the default EP path: static
+    (B,E,C,D) dispatch whose ``expert``-axis constrain turns into
+    all-to-alls.
   * ``_moe_ffn_einsum`` (masked one-hot einsums) — inside manual regions
     (pipeline stages), where the partitioner cannot handle batch-sharded
     index ops; and small-shape EP, where 0/1 dispatch einsums beat
@@ -54,6 +60,8 @@ from pyrecover_tpu.parallel.mesh import (
     AXIS_DATA,
     AXIS_EXPERT,
     AXIS_FSDP,
+    AXIS_SEQ,
+    AXIS_TENSOR,
     constrain,
 )
 
@@ -61,6 +69,45 @@ from pyrecover_tpu.parallel.mesh import (
 def moe_capacity(seq_len, n_experts, top_k, capacity_factor):
     """Per-row expert capacity: ceil(S·k·cf / E), min 1. Static."""
     return max(1, int(math.ceil(seq_len * top_k * capacity_factor / n_experts)))
+
+
+def _route(h, router_w, E, K, C):
+    """THE routing definition every dispatch backend shares — f32 softmax,
+    Mixtral-renormalized top-k gates, first-come-first-served capacity in
+    (s, k) flat pick order. One definition makes the backends' pinned
+    equality structural instead of five hand-synchronized copies.
+
+    Returns ``(probs, eids, gvals, onehot, rank, valid)``:
+      probs (B,S,E) f32; eids/gvals/rank/valid (B,N) with N = S·K in
+      (s, k) flat order; onehot (B,N,E) int32.
+    """
+    B, S, _ = h.shape
+    N = S * K
+    f32 = jnp.float32
+    logits = jnp.einsum("bsd,de->bse", h.astype(f32), router_w.astype(f32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, K)  # (B,S,K)
+    gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+    eids = gate_idx.reshape(B, N)
+    gvals = gate_vals.reshape(B, N)
+    onehot = (
+        eids[:, :, None] == jnp.arange(E, dtype=eids.dtype)[None, None, :]
+    ).astype(jnp.int32)
+    # queue position within the pick's expert: exclusive cumsum over the
+    # small (B,N,E) one-hot — FCFS, no sort, no C-sized slot tensor
+    prio = jnp.cumsum(onehot, axis=1) - onehot
+    rank = jnp.sum(prio * onehot, axis=-1)
+    valid = rank < C
+    return probs, eids, gvals, onehot, rank, valid
+
+
+def _switch_aux(probs, onehot, E, N):
+    """Switch load-balance aux loss per row: E · Σ_e f_e·p_e, where f_e is
+    the pre-capacity fraction of picks routed to e and p_e the mean router
+    probability. Minimized (=1) by a uniform router."""
+    f_e = jnp.sum(onehot, axis=1).astype(jnp.float32) / N  # (B,E)
+    p_e = probs.mean(axis=1)  # (B,E)
+    return E * jnp.sum(f_e * p_e, axis=-1)  # (B,) f32
 
 
 def moe_ffn(h, router_w, w1, w3, w2, config):
@@ -108,23 +155,16 @@ def moe_ffn(h, router_w, w1, w3, w2, config):
     if choice == "auto" and ep == 1:
         # Grouped ragged GEMMs whenever the expert axis is unsharded: the
         # per-row sort/gather keeps data/fsdp sharding intact, and the
-        # expert FFNs run as dense per-expert matmuls on the MXU (measured
-        # v5e moe-4x1b fwd+bwd: grouped ~2.1x the scatter path's step rate
-        # — the 34.5%-active-MFU shortfall BENCH_r03 exposed). With ep > 1
-        # keep the scatter/einsum forms, whose (B,E,C,D) constrain is what
-        # turns dispatch into all-to-alls over the expert axis.
+        # expert FFNs run as dense per-expert matmuls on the MXU — built to
+        # close the 34.5%-active-MFU shortfall BENCH_r03 exposed (projected
+        # from the dispatch-cost model; equivalence-tested, awaiting an
+        # on-chip A/B via `bench.py --moe-dispatch`). With ep > 1 the auto
+        # pick stays with the scatter/einsum forms until the explicitly-
+        # SPMD grouped path below is measured on chip.
         return _moe_ffn_grouped(h, router_w, w1, w3, w2, config)
     if choice == "grouped":
         if ep > 1:
-            # the grouped path has no expert-axis dispatch constrain, so
-            # GSPMD would allgather the expert-sharded weights onto every
-            # device — silently un-sharding EP. Refuse rather than degrade.
-            raise ValueError(
-                "moe_dispatch='grouped' is incompatible with an expert-"
-                f"sharded mesh (ep={ep}): the ragged-GEMM dispatch cannot "
-                "express expert all-to-alls. Use 'auto', 'scatter', or "
-                "'einsum' with --ep > 1."
-            )
+            return _moe_ffn_grouped_ep(h, router_w, w1, w3, w2, config, mesh)
         return _moe_ffn_grouped(h, router_w, w1, w3, w2, config)
     if choice == "auto":
         # Measured on v5e (8x150m, S=1024, fwd+bwd per MoE layer): einsum
@@ -159,25 +199,8 @@ def _moe_ffn_impl(h, router_w, w1, w3, w2, config):
     E, K = cfg.n_experts, cfg.moe_top_k
     C = moe_capacity(S, E, K, cfg.moe_capacity_factor)
     N = S * K
-    f32 = jnp.float32
 
-    # --- routing (f32 for a stable softmax) ---
-    logits = jnp.einsum("bsd,de->bse", h.astype(f32), router_w.astype(f32))
-    probs = jax.nn.softmax(logits, axis=-1)  # (B,S,E)
-    gate_vals, gate_idx = jax.lax.top_k(probs, K)  # (B,S,K)
-    gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
-
-    # --- capacity assignment: each pick's queue position within its expert
-    # is an exclusive cumsum over the small (B,N,E) one-hot in (s, k) flat
-    # order — first-come-first-served, no sort, no C-sized slot tensor ---
-    eids = gate_idx.reshape(B, N)
-    gvals = gate_vals.reshape(B, N)
-    onehot = (
-        eids[:, :, None] == jnp.arange(E, dtype=eids.dtype)[None, None, :]
-    ).astype(jnp.int32)  # (B,N,E)
-    prio = jnp.cumsum(onehot, axis=1) - onehot
-    rank = jnp.sum(prio * onehot, axis=-1)  # (B,N) position in expert queue
-    valid = rank < C
+    probs, eids, gvals, onehot, rank, valid = _route(h, router_w, E, K, C)
     # overflow entries: clamp to a real slot but zero their payload — a
     # scatter-ADD of zeros is a no-op, and in-capacity slots are unique so
     # add ≡ set. (Out-of-range "drop"/"fill" modes CHECK-fail in XLA's SPMD
@@ -211,14 +234,7 @@ def _moe_ffn_impl(h, router_w, w1, w3, w2, config):
     w = jnp.where(valid, gvals, 0.0).astype(cdt)
     y = jnp.sum((gathered * w[..., None]).reshape(B, S, K, D), axis=2)
 
-    # --- Switch load-balance aux loss, per row: E · Σ_e f_e·p_e where
-    # f_e = fraction of (token, slot) picks routed to e (pre-capacity;
-    # sums to 1 over experts), p_e = mean router probability over the row.
-    # Minimized (=1) by a uniform router; spikes when experts collapse. ---
-    f_e = jnp.sum(onehot, axis=1).astype(f32) / N  # (B,E) pre-capacity
-    p_e = probs.mean(axis=1)  # (B,E)
-    aux = E * jnp.sum(f_e * p_e, axis=-1)  # (B,) f32
-    return y.astype(h.dtype), aux
+    return y.astype(h.dtype), _switch_aux(probs, onehot, E, N)
 
 
 def _moe_ffn_grouped(h, router_w, w1, w3, w2, config):
@@ -243,21 +259,8 @@ def _moe_ffn_grouped(h, router_w, w1, w3, w2, config):
     E, K = cfg.n_experts, cfg.moe_top_k
     C = moe_capacity(S, E, K, cfg.moe_capacity_factor)
     N = S * K
-    f32 = jnp.float32
 
-    # --- routing: identical math to the scatter backend ---
-    logits = jnp.einsum("bsd,de->bse", h.astype(f32), router_w.astype(f32))
-    probs = jax.nn.softmax(logits, axis=-1)  # (B,S,E)
-    gate_vals, gate_idx = jax.lax.top_k(probs, K)  # (B,S,K)
-    gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
-    eids = gate_idx.reshape(B, N)
-    gvals = gate_vals.reshape(B, N)
-    onehot = (
-        eids[:, :, None] == jnp.arange(E, dtype=eids.dtype)[None, None, :]
-    ).astype(jnp.int32)  # (B,N,E)
-    prio = jnp.cumsum(onehot, axis=1) - onehot
-    rank = jnp.sum(prio * onehot, axis=-1)  # (B,N)
-    valid = rank < C
+    probs, eids, gvals, onehot, rank, valid = _route(h, router_w, E, K, C)
 
     # --- expert-sort each row's picks; group sizes = routing histogram
     # (pre-capacity: overflow picks stay in their group as zero rows, so
@@ -289,10 +292,170 @@ def _moe_ffn_grouped(h, router_w, w1, w3, w2, config):
     w = jnp.where(valid, gvals, 0.0).astype(cdt)
     y = jnp.sum((y_picks * w[..., None]).reshape(B, S, K, D), axis=2)
 
-    f_e = jnp.sum(onehot, axis=1).astype(f32) / N  # (B,E) pre-capacity
-    p_e = probs.mean(axis=1)
-    aux = E * jnp.sum(f_e * p_e, axis=-1)
-    return y.astype(h.dtype), aux
+    return y.astype(h.dtype), _switch_aux(probs, onehot, E, N)
+
+
+def _moe_ffn_grouped_ep(h, router_w, w1, w3, w2, config, mesh):
+    """Grouped ragged-GEMM dispatch under an expert-sharded mesh (ep > 1):
+    the MXU MoE path composed with expert parallelism.
+
+    Written as an explicitly-SPMD ``jax.shard_map`` manual over EVERY mesh
+    axis — the partial-manual partitioner CHECK-fails on gathers whose
+    indices derive from sharded operands (see ``moe_ffn``), so nothing is
+    left to it. The EP data flow exploits that activations are replicated
+    along the expert axis (batch shards over data×fsdp only): instead of a
+    materialized all-to-all exchange, every expert shard routes its OWN
+    batch rows, keeps only the picks owned by its local experts, runs the
+    ragged GEMMs over those, and one all-reduce over (expert, tensor) sums
+    the disjoint per-shard partial outputs — each valid pick contributes on
+    exactly one expert shard. The exchange all-to-all and the combine
+    reduction collapse into that single psum; compute per shard is bounded
+    by the static slice N_cap = E_loc·C rows (the capacity bound), so EP
+    divides the expert FLOPs by ep exactly like the scatter path's
+    (B,E,C,D) form, with dense contiguous GEMMs instead of scatters.
+
+    Routing math (full-E softmax/top-k/FCFS capacity on whole rows) is
+    bit-identical to every other backend — rows are never split, so
+    capacity and the aux loss are exact, and the backends stay
+    equality-pinned. fsdp-sharded weight dims are all-gathered on entry
+    (ZeRO-3; transposes to reduce-scatter under AD).
+
+    Constraints (ValueError otherwise): n_experts % ep == 0, and the
+    sequence axis must be unsharded — this path would silently un-shard a
+    sequence-parallel activation at the shard_map boundary; use
+    scatter/einsum dispatch with sp > 1.
+    """
+    cfg = config
+    B, S, D = h.shape
+    E, K = cfg.n_experts, cfg.moe_top_k
+    ep = mesh.shape.get(AXIS_EXPERT, 1)
+    if E % ep != 0:
+        raise ValueError(
+            f"moe_dispatch='grouped' with ep={ep} needs n_experts % ep == 0 "
+            f"(got E={E})"
+        )
+    if mesh.shape.get(AXIS_SEQ, 1) > 1:
+        raise ValueError(
+            "moe_dispatch='grouped' with ep > 1 does not compose with a "
+            "sharded sequence axis (it would un-shard the activations); "
+            "use moe_dispatch='scatter' or 'einsum' under sp > 1."
+        )
+    E_loc = E // ep
+    C = moe_capacity(S, E, K, cfg.moe_capacity_factor)
+    N = S * K
+    N_cap = min(N, E_loc * C)
+    from jax.sharding import PartitionSpec as P
+
+    def _vary(x, names):
+        # pcast one axis at a time; only over axes the value is still
+        # invariant on (pcast rejects already-varying axes)
+        for n in names:
+            x = jax.lax.pcast(x, (n,), to="varying")
+        return x
+
+    def local_fn(h_loc, rw, w1_loc, w3_loc, w2_loc):
+        f32 = jnp.float32
+        cdt = h_loc.dtype
+        Bl = h_loc.shape[0]
+        # AD-CORRECTNESS, not style: every value the y path differentiates
+        # is pcast to varying over the axes its in_spec leaves it invariant
+        # on. Leaving them invariant MISCOMPILES the backward pass — the
+        # vma system drops/misplaces the invariant→varying transition's
+        # hidden psum once the sorted keep-mask multiply appears between
+        # the two index-gathers (measured: dh off by ~30% vs finite
+        # differences, same wrong value for ragged and dense-einsum expert
+        # compute; pcast-at-entry restores AD == FD). Same hazard family
+        # as the pipeline's stage-divergent lax.cond rule
+        # (parallel/pipeline.py).
+        h_v = _vary(h_loc, (AXIS_EXPERT, AXIS_TENSOR))
+        rw_v = _vary(rw, (AXIS_EXPERT, AXIS_TENSOR, AXIS_DATA, AXIS_FSDP))
+        # ZeRO-3: gather the fsdp-sharded weight dims for compute
+        w1g = jax.lax.all_gather(
+            _vary(w1_loc, (AXIS_DATA,)), AXIS_FSDP, axis=1, tiled=True
+        )
+        w3g = jax.lax.all_gather(
+            _vary(w3_loc, (AXIS_DATA,)), AXIS_FSDP, axis=1, tiled=True
+        )
+        w2g = jax.lax.all_gather(
+            _vary(w2_loc, (AXIS_DATA,)), AXIS_FSDP, axis=2, tiled=True
+        )
+
+        # --- routing: the shared definition, on the VARYING values ---
+        _, eids, gvals, _, _, valid = _route(h_v, rw_v, E, K, C)
+
+        # --- picks owned by THIS expert shard; sentinel E_loc sorts
+        # non-local and capacity-dropped picks to the tail ---
+        e0 = jax.lax.axis_index(AXIS_EXPERT) * E_loc
+        local = valid & (eids >= e0) & (eids < e0 + E_loc)
+        lids = jnp.where(local, eids - e0, E_loc)
+        order = jnp.argsort(lids, axis=1, stable=True)  # (Bl, N)
+        order_c = order[:, :N_cap]  # static capacity bound: ≤ C per expert
+        tok = order_c // K  # pick n came from token n // K
+        x = jnp.take_along_axis(h_v, tok[..., None], axis=1)  # (Bl,N_cap,D)
+        keep = jnp.take_along_axis(local, order_c, axis=1)
+        x = x * keep[..., None].astype(cdt)
+        sizes = jnp.sum(
+            (lids[:, :, None] == jnp.arange(E_loc, dtype=lids.dtype)).astype(
+                jnp.int32
+            ),
+            axis=1,
+        )  # (Bl, E_loc): per-local-expert valid pick counts, each ≤ C
+
+        rdn = jax.lax.RaggedDotDimensionNumbers(
+            dot_dimension_numbers=(((2,), (1,)), ((), ())),
+            lhs_ragged_dimensions=[1],
+            rhs_group_dimensions=[0],
+        )
+        gate = jax.nn.silu(
+            jax.lax.ragged_dot_general(x, w1g.astype(cdt), sizes, rdn)
+        )
+        up = jax.lax.ragged_dot_general(x, w3g.astype(cdt), sizes, rdn)
+        out = jax.lax.ragged_dot_general(
+            gate * up, w2g.astype(cdt), sizes, rdn
+        )  # (Bl, N_cap, D) in local-expert-sorted order
+        # rows past a row's group total belong to NO group — their content
+        # is unspecified; zero them before the combine gather
+        total = jnp.sum(sizes, axis=1)  # (Bl,)
+        row_ok = jnp.arange(N_cap)[None, :] < total[:, None]
+        out = out * row_ok[..., None].astype(cdt)
+
+        # --- combine: pad to N rows and gather each pick's sorted position
+        # (non-local picks land in the zero padding / zeroed tail) ---
+        out_ext = jnp.pad(out, ((0, 0), (0, N - N_cap), (0, 0)))
+        inv = jnp.argsort(order, axis=1)  # pick -> sorted position
+        y_picks = jnp.take_along_axis(out_ext, inv[..., None], axis=1)
+        wgt = jnp.where(local, gvals, 0.0).astype(cdt)
+        y_part = jnp.sum((y_picks * wgt[..., None]).reshape(Bl, S, K, D), axis=2)
+        # ONE all-reduce: sums the disjoint expert-shard contributions AND
+        # the row-parallel w2 partials over tensor. f32: sub-f32
+        # all-reduces CHECK-fail on the CPU backend (tests/virtual mesh).
+        y = jax.lax.psum(
+            y_part.astype(f32), (AXIS_EXPERT, AXIS_TENSOR)
+        ).astype(h_loc.dtype)
+
+        # aux from a SEPARATE routing graph on the un-pcast (invariant)
+        # values: numerically identical, but its cotangent flows once —
+        # through the varying graph it would arrive pre-psum'd over
+        # (expert, tensor), i.e. scaled by ep·tp — and the invariant aux
+        # satisfies its out_spec without a reduction.
+        probs_i, _, _, onehot_i, _, _ = _route(h_loc, rw, E, K, C)
+        aux = _switch_aux(probs_i, onehot_i, E, N)
+        return y, aux
+
+    batch = (AXIS_DATA, AXIS_FSDP)
+    return jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(
+            P(batch, None, None),
+            P(None, None),
+            P(AXIS_EXPERT, AXIS_FSDP, AXIS_TENSOR),
+            P(AXIS_EXPERT, AXIS_FSDP, AXIS_TENSOR),
+            P(AXIS_EXPERT, AXIS_TENSOR, AXIS_FSDP),
+        ),
+        out_specs=(P(batch, None, None), P(batch)),
+        axis_names=set(mesh.axis_names),
+    )(h, router_w, w1, w3, w2)
 
 
 def _moe_ffn_einsum(h, router_w, w1, w3, w2, config):
@@ -306,29 +469,28 @@ def _moe_ffn_einsum(h, router_w, w1, w3, w2, config):
     B, S, D = h.shape
     E, K = cfg.n_experts, cfg.moe_top_k
     C = moe_capacity(S, E, K, cfg.moe_capacity_factor)
-    f32 = jnp.float32
+    N = S * K
 
-    logits = jnp.einsum("bsd,de->bse", h.astype(f32), router_w.astype(f32))
-    probs = jax.nn.softmax(logits, axis=-1)  # (B,S,E)
-    gate_vals, gate_idx = jax.lax.top_k(probs, K)  # (B,S,K)
-    gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
-    onehot = jax.nn.one_hot(gate_idx, E, dtype=f32)  # (B,S,K,E)
+    probs, _, gvals, onehot, rank, valid = _route(h, router_w, E, K, C)
 
-    # queue position of each (token, slot) within its expert, (s, k) order.
-    # The cumsum stays f32 (exact integers), but the big (B,S,K,E,C) slot
-    # one-hot is built directly in the compute dtype: every (e, c) slot has
-    # exactly one contributor, so the K-sums below have no accumulation —
-    # bf16 here is exact 0/1 and halves the VPU traffic on the largest
-    # tensors of the dispatch.
+    # Build the (B,S,K,E,C) slot one-hot directly in the compute dtype:
+    # every (e, c) slot has exactly one contributor, so the K-sums below
+    # have no accumulation — bf16 here is exact 0/1 and halves the VPU
+    # traffic on the largest tensors of the dispatch. Only the SELECTED
+    # expert's queue position matters (keep masks the rest), so the slot
+    # one-hot comes straight from the shared rank.
     cdt = h.dtype
-    flat = onehot.reshape(B, S * K, E)
-    prio = jnp.cumsum(flat, axis=1) - flat  # 0-based queue position
-    prio = prio.reshape(B, S, K, E)
-    keep = (onehot * (prio < C)).astype(cdt)  # drop overflow tokens
-    slot = jax.nn.one_hot(prio.astype(jnp.int32), C, dtype=cdt)  # (B,S,K,E,C)
-    slot = slot * keep[..., None]
+    keep = (
+        onehot.reshape(B, S, K, E).astype(cdt)
+        * valid.reshape(B, S, K, 1).astype(cdt)
+    )  # drop overflow tokens
+    slot = keep[..., None] * jax.nn.one_hot(
+        rank.reshape(B, S, K), C, dtype=cdt
+    )[..., None, :]  # (B,S,K,E,C)
     dispatch = slot.sum(axis=2)  # (B,S,E,C) ∈ {0,1}
-    combine = (slot * gate_vals.astype(cdt)[..., None, None]).sum(axis=2)
+    combine = (slot * gvals.reshape(B, S, K).astype(cdt)[..., None, None]).sum(
+        axis=2
+    )
 
     xin = jnp.einsum("bsec,bsd->becd", dispatch, h)
     xin = constrain(xin, (AXIS_DATA, AXIS_FSDP), AXIS_EXPERT, None, None)
@@ -338,7 +500,4 @@ def _moe_ffn_einsum(h, router_w, w1, w3, w2, config):
     out = constrain(out, (AXIS_DATA, AXIS_FSDP), AXIS_EXPERT, None, None)
     y = jnp.einsum("bsec,becd->bsd", combine, out)
 
-    f_e = onehot.mean(axis=(1, 2))  # (B,E)
-    p_e = probs.mean(axis=1)  # (B,E)
-    aux = E * jnp.sum(f_e * p_e, axis=-1)
-    return y.astype(h.dtype), aux
+    return y.astype(h.dtype), _switch_aux(probs, onehot, E, N)
