@@ -118,3 +118,101 @@ def test_generate_rejects_overflow():
 
     with pytest.raises(ValueError, match="exceeds the cache"):
         generate_tokens(params, CFG, [1] * 30, 10)
+
+
+def test_blockwise_cache_crosses_block_boundaries():
+    """A cache longer than one decode block must reproduce the training
+    forward across positions spanning several blocks — the online-softmax
+    block accumulation and the fill-bounded trip count are both exercised
+    (tiny model, long sequence)."""
+    cfg = dataclasses.replace(
+        CFG, max_seq_len=640, dim=32, n_layers=1, n_heads=2, n_kv_heads=1
+    )
+    params, tokens = make_inputs(cfg=cfg, b=1, s=600, seed=4)
+    ref = jax.jit(lambda p, t: forward(p, t, cfg))(params, tokens)
+    from pyrecover_tpu.models.decode import _DECODE_BLOCK
+
+    cache = init_kv_cache(cfg, 1, cfg.max_seq_len)
+    assert cache["k"].shape[2] % _DECODE_BLOCK == 0  # padded up, aligned
+    step = jax.jit(lambda p, c, t, pos: decode_forward(p, c, t, pos, cfg))
+    # prefill 520 positions (crosses two block boundaries at 256 and 512)
+    logits, cache = step(params, cache, tokens[:, :520], 0)
+    np.testing.assert_allclose(
+        np.asarray(logits[:, -1]), np.asarray(ref[:, 519]),
+        rtol=5e-5, atol=5e-5,
+    )
+    # chunk=1 steps across the 512-block edge
+    for pos in range(520, 530):
+        logits, cache = step(params, cache, tokens[:, pos : pos + 1], pos)
+        np.testing.assert_allclose(
+            np.asarray(logits[:, 0]), np.asarray(ref[:, pos]),
+            rtol=1e-4, atol=1e-4, err_msg=f"pos {pos}",
+        )
+
+
+def test_decode_step_cost_scales_with_fill_not_max_len():
+    """The round-4 weakness this rewrite fixes: a decode step near pos=0
+    must not pay for the whole cache. Measured: median chunk=1 step time
+    with a 16x larger cache stays within 4x (the full-cache scoring it
+    replaces is ~16x); the compiled step contains a while loop (the
+    traced-trip-count block iteration)."""
+    import time
+
+    cfg = dataclasses.replace(
+        CFG, max_seq_len=8192, dim=32, n_layers=1, n_heads=2, n_kv_heads=1
+    )
+    params, tokens = make_inputs(cfg=cfg, b=1, s=8, seed=5)
+
+    def timed_step(max_len):
+        cache = init_kv_cache(cfg, 1, max_len)
+        step = jax.jit(
+            lambda p, c, t, pos: decode_forward(p, c, t, pos, cfg)
+        )
+        _, cache = step(params, cache, tokens, 0)  # prefill + compile
+        tk = tokens[:, :1]
+        out, _ = step(params, cache, tk, 8)
+        out.block_until_ready()  # warm the chunk=1 compile
+        times = []
+        for _ in range(7):
+            t0 = time.perf_counter()
+            out, _ = step(params, cache, tk, 8)
+            out.block_until_ready()
+            times.append(time.perf_counter() - t0)
+        return sorted(times)[len(times) // 2]
+
+    t_small = timed_step(512)
+    t_big = timed_step(8192)
+    # generous bound: the full-cache scoring this replaced is ~16x; the
+    # 1-core throttled test box is noisy, so the hard guard is the jaxpr
+    # pin below and this only catches gross regressions
+    assert t_big < 6 * t_small + 5e-3, (
+        f"decode step at 16x cache capacity took {t_big*1e3:.2f}ms vs "
+        f"{t_small*1e3:.2f}ms — cost is scaling with max_len, not fill"
+    )
+    # structural pin, at the JAXPR level where it discriminates: the layer
+    # scan stays a `scan` primitive, so `while` appears ONLY for the
+    # traced-trip-count block iteration — present for a multi-block cache,
+    # absent for the single-shot path
+    def jaxpr_for(max_len):
+        cache = init_kv_cache(cfg, 1, max_len)
+        return str(jax.make_jaxpr(
+            lambda p, c, t, pos: decode_forward(p, c, t, pos, cfg)
+        )(params, cache, tokens[:, :1], 8))
+
+    assert "while" in jaxpr_for(8192)
+    assert "while" not in jaxpr_for(256)
+
+
+def test_generate_batched_matches_individual():
+    """Batched generation (equal-length prompts, one cache, lockstep
+    decode) must emit exactly what per-prompt generation emits."""
+    params, _ = make_inputs()
+    prompts = [[1, 2, 3], [7, 5, 9], [4, 4, 4]]
+    individual = [generate_tokens(params, CFG, p, 6) for p in prompts]
+    batched = generate_tokens(params, CFG, prompts, 6)
+    assert batched == individual
+
+    import pytest
+
+    with pytest.raises(ValueError, match="EQUAL-length"):
+        generate_tokens(params, CFG, [[1, 2], [3]], 4)
