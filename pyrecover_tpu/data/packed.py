@@ -100,8 +100,12 @@ class PackedParquetTextDataset:
                 ):
                     self._stream = stream
             except Exception:
-                self._stream = None  # stale/torn: slice path disabled
-        if lengths is None:
+                self._stream = None  # stale/torn: rebuilt below
+        # rebuild when EITHER product is missing: a warm pre-stream length
+        # index (or a torn stream file) must not silently pin every future
+        # restart to the re-tokenize fallback — one repair pass writes the
+        # pair and restores the pure-slice path
+        if lengths is None or self._stream is None:
             doc_tokens = [self._tokenize(d) for d in range(self.real_docs)]
             lengths = np.asarray([len(t) for t in doc_tokens], dtype=np.int64)
             stream = (

@@ -262,6 +262,7 @@ def generate_tokens(params, config, prompt_ids, max_new_tokens, *,
         )
         last = logits[:, 0]
         pos += 1
-    for row, col in zip(out, np.asarray(jnp.stack(generated, axis=1))):
-        row.extend(int(v) for v in col)
+    if generated:  # max_new_tokens=0 returns the prompts unchanged
+        for row, col in zip(out, np.asarray(jnp.stack(generated, axis=1))):
+            row.extend(int(v) for v in col)
     return out[0] if single else out

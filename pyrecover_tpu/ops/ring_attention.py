@@ -57,17 +57,26 @@ def _score_mask(seg_q, seg_k, q_start, k_start, sq, sk, causal):
 
 
 def _block_update(qg, k, v, seg_q, seg_k, q_start, k_start, scale, causal,
-                  m, l, acc):
+                  m, l, acc, k_len=None):
     """One online-softmax update of local q against one KV sub-block.
     Shapes: qg (B, Sq, Hkv, G, D); k/v (B, Sk, Hkv, D); seg_q/seg_k
     (B, Sq)/(B, Sk) int32 or None. State m/l: (B, Hkv, G, Sq, 1) f32;
-    acc: (B, Sq, Hkv, G, D) f32."""
+    acc: (B, Sq, Hkv, G, D) f32. ``k_len`` (traced scalar) masks the
+    ragged tail of a padded sub-block: entries at local index >= k_len are
+    invalid (their padded global positions would alias the NEXT chunk's,
+    so the causal mask alone cannot exclude them)."""
     sq, sk = qg.shape[1], k.shape[1]
     s = jnp.einsum("bqkgd,bskd->bkgqs", qg, k,
                    preferred_element_type=jnp.float32) * jnp.float32(scale)
     mask = _score_mask(seg_q, seg_k, q_start, k_start, sq, sk, causal)
     if mask is not None:
         s = jnp.where(mask[:, None, None], s, jnp.float32(_NEG_INF))
+    if k_len is not None:
+        kidx = jnp.arange(sk, dtype=jnp.int32)
+        s = jnp.where(
+            (kidx < k_len)[None, None, None, None, :], s,
+            jnp.float32(_NEG_INF),
+        )
     m_cur = jnp.max(s, axis=-1, keepdims=True)
     m_new = jnp.maximum(m, m_cur)
     p = jnp.exp(s - m_new)
@@ -82,29 +91,21 @@ def _block_update(qg, k, v, seg_q, seg_k, q_start, k_start, scale, causal,
 
 
 def _split_blocks(x, block):
-    """(B, S, ...) → (nb, B, block, ...) when S divides evenly, else 1 block.
-
-    The single-block fallback materializes the full (Sq × Sk_chunk) score
-    matrix — exactly what the blockwise form exists to avoid — so a
-    non-divisible per-device chunk warns loudly (trace-time, once per
-    compile) instead of silently losing the memory bound."""
+    """(B, S, ...) → (nb, B, block, ...), padding a non-divisible S up to a
+    whole number of blocks (the flash kernel's ragged-edge pattern,
+    ops/flash_attention.py): the blockwise (Sq × block_kv) memory bound
+    holds for ANY per-device chunk size. Padded tail entries are masked by
+    the caller via each sub-block's valid length (``k_len``). S <= block
+    stays a single unpadded block."""
     s = x.shape[1]
-    if block and s % block == 0 and s > block:
-        nb = s // block
-        return jnp.moveaxis(
-            x.reshape(x.shape[0], nb, block, *x.shape[2:]), 1, 0
+    if not block or s <= block:
+        return x[None]
+    nb = -(-s // block)
+    if s % block:
+        x = jnp.pad(
+            x, ((0, 0), (0, nb * block - s)) + ((0, 0),) * (x.ndim - 2)
         )
-    if block and s > block:
-        from pyrecover_tpu.utils.logging import log_host0
-
-        log_host0(
-            "ring attention: per-device KV chunk %d not divisible by "
-            "block_kv %d; falling back to ONE full-size block — the "
-            "(Sq x Sk_chunk) score matrix is materialized. Pick a "
-            "block_kv dividing seq_len/ring_size to keep the memory bound.",
-            s, block,
-        )
-    return x[None]
+    return jnp.moveaxis(x.reshape(x.shape[0], nb, block, *x.shape[2:]), 1, 0)
 
 
 def _chunk_update(qg, k, v, seg_q, seg_k, q_start, k_start, scale, causal,
@@ -115,6 +116,8 @@ def _chunk_update(qg, k, v, seg_q, seg_k, q_start, k_start, scale, causal,
     vb = _split_blocks(v, block_kv)
     sb = None if seg_k is None else _split_blocks(seg_k, block_kv)
     blk = kb.shape[2]
+    sk_real = k.shape[1]
+    ragged = sk_real % blk != 0  # static: only then is a tail mask needed
 
     def body(carry, inp):
         m, l, acc = carry
@@ -123,9 +126,10 @@ def _chunk_update(qg, k, v, seg_q, seg_k, q_start, k_start, scale, causal,
             ss = None
         else:
             i, kk, vv, ss = inp
+        k_len = jnp.minimum(sk_real - i * blk, blk) if ragged else None
         m, l, acc = _block_update(
             qg, kk, vv, seg_q, ss, q_start, k_start + i * blk, scale,
-            causal, m, l, acc,
+            causal, m, l, acc, k_len=k_len,
         )
         return (m, l, acc), None
 
@@ -191,16 +195,23 @@ def _ring_fwd_local(q, k, v, seg, *, axis_name, causal, scale, block_kv):
 
 
 def _block_bwd(qg, k, v, seg_q, seg_k, do_g, delta, lse, q_start, k_start,
-               scale, causal):
+               scale, causal, k_len=None):
     """Recompute one KV sub-block's probabilities from (q, k, lse) and
     return (dq_contrib, dk_block, dv_block) — flash-attention backward
-    algebra."""
+    algebra. ``k_len`` masks a padded ragged tail exactly as in the
+    forward (p = 0 there, so dk/dv tail rows come out zero)."""
     sq, sk = qg.shape[1], k.shape[1]
     s = jnp.einsum("bqkgd,bskd->bkgqs", qg, k,
                    preferred_element_type=jnp.float32) * jnp.float32(scale)
     mask = _score_mask(seg_q, seg_k, q_start, k_start, sq, sk, causal)
     if mask is not None:
         s = jnp.where(mask[:, None, None], s, jnp.float32(_NEG_INF))
+    if k_len is not None:
+        kidx = jnp.arange(sk, dtype=jnp.int32)
+        s = jnp.where(
+            (kidx < k_len)[None, None, None, None, :], s,
+            jnp.float32(_NEG_INF),
+        )
     p = jnp.exp(s - lse)  # (B,Hkv,G,Sq,Sk); masked entries exp(-inf)=0
     dv = jnp.einsum("bkgqs,bqkgd->bskd", p, do_g,
                     preferred_element_type=jnp.float32)
@@ -224,6 +235,8 @@ def _chunk_bwd(qg, k, v, seg_q, seg_k, do_g, delta, lse, q_start, k_start,
     vb = _split_blocks(v, block_kv)
     sb = None if seg_k is None else _split_blocks(seg_k, block_kv)
     nb, blk = kb.shape[0], kb.shape[2]
+    sk_real = k.shape[1]
+    ragged = sk_real % blk != 0
 
     def body(dq, inp):
         if sb is None:
@@ -231,9 +244,10 @@ def _chunk_bwd(qg, k, v, seg_q, seg_k, do_g, delta, lse, q_start, k_start,
             ss = None
         else:
             i, kk, vv, ss = inp
+        k_len = jnp.minimum(sk_real - i * blk, blk) if ragged else None
         dq_c, dk_b, dv_b = _block_bwd(
             qg, kk, vv, seg_q, ss, do_g, delta, lse, q_start,
-            k_start + i * blk, scale, causal,
+            k_start + i * blk, scale, causal, k_len=k_len,
         )
         return dq + dq_c, (dk_b, dv_b)
 
@@ -243,9 +257,14 @@ def _chunk_bwd(qg, k, v, seg_q, seg_k, do_g, delta, lse, q_start, k_start,
     dq, (dk_b, dv_b) = jax.lax.scan(
         body, jnp.zeros(qg.shape, dtype=jnp.float32), xs,
     )
-    # (nb, B, blk, Hkv, D) → (B, Sk_chunk, Hkv, D)
-    dk = jnp.moveaxis(dk_b, 0, 1).reshape(k.shape)
-    dv = jnp.moveaxis(dv_b, 0, 1).reshape(v.shape)
+    # (nb, B, blk, Hkv, D) → (B, Sk_chunk, Hkv, D); a padded tail block's
+    # zero rows are sliced back off
+    dk = jnp.moveaxis(dk_b, 0, 1).reshape(
+        k.shape[0], nb * blk, *k.shape[2:]
+    )[:, :sk_real]
+    dv = jnp.moveaxis(dv_b, 0, 1).reshape(
+        v.shape[0], nb * blk, *v.shape[2:]
+    )[:, :sk_real]
     return dq, dk, dv
 
 

@@ -216,3 +216,6 @@ def test_generate_batched_matches_individual():
 
     with pytest.raises(ValueError, match="EQUAL-length"):
         generate_tokens(params, CFG, [[1, 2], [3]], 4)
+
+    # max_new_tokens=0 returns the prompts unchanged
+    assert generate_tokens(params, CFG, [1, 2, 3], 0) == [1, 2, 3]
