@@ -391,9 +391,15 @@ def train(config: TrainConfig):
             config.checkpoint_dir, config.experiment_name, step,
             final=final, sharded=config.sharded_checkpoint,
         )
-        state_to_save = dataclasses.replace(
-            state, epoch=jnp.asarray(sampler_epoch_of(step), dtype=jnp.int32)
+        # mesh-replicated GLOBAL scalar, like every other state leaf: a
+        # bare jnp.asarray would be host-local, which the multi-host
+        # sharded engine refuses to serialize ("Cannot serialize host
+        # local jax.Array" — found by the 2-process driver test)
+        epoch = jax.device_put(
+            np.asarray(sampler_epoch_of(step), np.int32),
+            NamedSharding(mesh, P()),
         )
+        state_to_save = dataclasses.replace(state, epoch=epoch)
         sampler_meta = {"consumed": int(step), **sampler.state_dict()}
         extra = {"step": int(step), "epoch": sampler_epoch_of(step)}
         if config.sharded_checkpoint:

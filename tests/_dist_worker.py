@@ -13,11 +13,122 @@ import jax
 import os
 
 
+def _run_train(workdir, **overrides):
+    """One `train()` call with the tiny 2-proc config (the REAL driver —
+    resume, preemption, checkpoint strategy dispatch all included)."""
+    from pyrecover_tpu.config import TrainConfig
+    from pyrecover_tpu.models import ModelConfig
+    from pyrecover_tpu.train import train
+
+    base = dict(
+        sequence_length=32, batch_size=8, training_samples=64,
+        training_steps=8, learning_rate=1e-3, lr_warmup_steps=2, seed=13,
+        checkpoint_dir=workdir, checkpoint_frequency=4,
+        experiment_name="mp", logging_frequency=100,
+        verify_checkpoints=True,
+    )
+    base.update(overrides)
+    cfg = TrainConfig(**base)
+    cfg.model = ModelConfig(
+        dim=64, n_layers=2, n_heads=4, n_kv_heads=2, vocab_size=128,
+        multiple_of=32, max_seq_len=32,
+    )
+    cfg.__post_init__()
+    return train(cfg)
+
+
+def _capture_host0_log():
+    """Collect the pyrecover log lines (host 0 emits; other hosts see
+    nothing — which is itself part of what the scenarios assert)."""
+    import logging
+
+    msgs = []
+
+    class _H(logging.Handler):
+        def emit(self, record):
+            msgs.append(record.getMessage())
+
+    from pyrecover_tpu.utils.logging import init_logger
+
+    init_logger().addHandler(_H())
+    return msgs
+
+
+def mode_preempt(proc_id, workdir):
+    """A preemption notice visible ONLY to host 0, landing mid-interval
+    (present from step 1; check interval 4): host 1 must learn the stop
+    through the check-step broadcast, and both hosts must exit together
+    with the final checkpoint — the deadlock mode the coordinated
+    protocol exists to prevent (reference train.py:342-346's rank-0 +
+    broadcast shape)."""
+    from pathlib import Path
+
+    from pyrecover_tpu.preempt import PREEMPT_NOTICE_ENV
+
+    notice = Path(workdir) / f"notice_{proc_id}"
+    os.environ[PREEMPT_NOTICE_ENV] = str(notice)  # per-proc: host-0-only
+    if proc_id == 0:
+        notice.write_text("preempt")
+    msgs = _capture_host0_log()
+    _, end_step, stopped = _run_train(
+        workdir, training_steps=100, timeaware_checkpointing=True,
+        preempt_check_interval=4, checkpoint_frequency=50,
+    )
+    exp = Path(workdir) / "mp"
+    return {
+        "end_step": end_step,
+        "stopped": stopped,
+        "requeue": (exp / "REQUEUE").exists(),
+        "finals": sorted(p.name for p in exp.glob("ckpt_*_final*")),
+        "midinterval_logged": any(
+            "mid-interval" in m for m in msgs
+        ),
+    }
+
+
+def mode_resume(proc_id, workdir, sharded):
+    """Corrupt-newest resume, coordinated: train 8 steps, host 0 tears the
+    newest checkpoint, then BOTH hosts resume from 'latest' — the host-0
+    integrity verdict broadcast must walk every host back to the same
+    intact candidate (ckpt_4) without desynchronizing the collective
+    load."""
+    from pathlib import Path
+
+    from pyrecover_tpu.parallel.mesh import sync_global_devices
+
+    _run_train(workdir, sharded_checkpoint=sharded)
+    sync_global_devices("pre_corrupt")
+    exp = Path(workdir) / "mp"
+    if proc_id == 0:
+        if sharded:
+            (exp / "ckpt_8_final" / "_CHECKPOINT_METADATA").unlink()
+        else:
+            newest = exp / "ckpt_8_final.ckpt"
+            data = newest.read_bytes()
+            newest.write_bytes(data[: len(data) // 2])
+    sync_global_devices("post_corrupt")
+    msgs = _capture_host0_log()
+    _, end_step, stopped = _run_train(
+        workdir, sharded_checkpoint=sharded, resume_from_checkpoint="latest"
+    )
+    return {
+        "end_step": end_step,
+        "stopped": stopped,
+        "fallback_logged": any(
+            "failed integrity pre-check" in m and "ckpt_8" in m for m in msgs
+        ),
+        "resumed_from_4": any(
+            "Resumed from" in m and "ckpt_4" in m for m in msgs
+        ),
+    }
+
+
 def main():
     proc_id = int(sys.argv[1])
     num_procs = int(sys.argv[2])
     port = sys.argv[3]
     workdir = sys.argv[4]
+    mode = sys.argv[5] if len(sys.argv) > 5 else "smoke"
 
     jax.distributed.initialize(
         coordinator_address=f"127.0.0.1:{port}",
@@ -25,6 +136,20 @@ def main():
         process_id=proc_id,
     )
     assert jax.process_count() == num_procs
+
+    if mode != "smoke":
+        if mode == "preempt":
+            result = mode_preempt(proc_id, workdir)
+        elif mode == "resume_vanilla":
+            result = mode_resume(proc_id, workdir, sharded=False)
+        elif mode == "resume_sharded":
+            result = mode_resume(proc_id, workdir, sharded=True)
+        else:
+            raise SystemExit(f"unknown mode {mode}")
+        result["proc"] = proc_id
+        print("WORKER_RESULT " + json.dumps(result))
+        jax.distributed.shutdown()
+        return
 
     import numpy as np
 
