@@ -13,6 +13,12 @@ already-resolved backends.
 """
 
 import os
+import sys
+from pathlib import Path as _Path
+
+# tools/ scripts are imported by tests (test_tools.py, test_pipeline.py);
+# anchor the path at the repo root so pytest works from any cwd
+sys.path.insert(0, str(_Path(__file__).resolve().parent.parent / "tools"))
 
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"

@@ -208,6 +208,42 @@ def test_1f1b_reduces_peak_memory_remat_off(devices8):
     assert temps["1f1b"] < temps["gpipe"] * 0.8, temps
 
 
+def test_1f1b_vs_gpipe_accum_memory_boundary(devices8):
+    """Transparency pin for the quantified 1F1B/accumulation boundary
+    (PARITY.md; tools/pp_memory_sweep.py). Regime A (fixed global batch):
+    1F1B compiles to LESS temp memory than the equivalent GPipe+accum at
+    both ends of the M range, and raising M does not raise 1F1B's memory
+    (boundary bytes are M-independent: 2·(M/S) queued microbatches whose
+    size shrinks as 1/M). Regime B (fixed microbatch size, batch grown
+    via M): 1F1B's boundary term GROWS with the batch while GPipe+accum
+    stays ~flat — the crossover's existence in the scaling limit."""
+    from pp_memory_sweep import BASE_M, measure  # tools/ on path (conftest)
+
+    mesh = create_mesh(MeshConfig(data=2, pipeline=4))
+    base = dataclasses.replace(MODEL_CFG, remat=False)
+
+    def temp(sched, batch, m, accum):
+        cfg = dataclasses.replace(base, pp_microbatches=m, pp_schedule=sched)
+        return measure(mesh, cfg, batch, accum)
+
+    # Regime A: fixed batch 64
+    f_lo = temp("1f1b", 64, BASE_M, 1)
+    g_lo = temp("gpipe", 64, BASE_M, 1)
+    f_hi = temp("1f1b", 64, 64, 1)
+    g_hi = temp("gpipe", 64, BASE_M, 64 // BASE_M)
+    assert f_lo < g_lo and f_hi < g_hi, (f_lo, g_lo, f_hi, g_hi)
+    assert f_hi <= f_lo * 1.1, (f_lo, f_hi)  # raising M is memory-free
+
+    # Regime B: fixed microbatch size (2 rows), batch 16 -> 128
+    fb_lo = temp("1f1b", 16, BASE_M, 1)
+    fb_hi = temp("1f1b", 128, 64, 1)
+    gb_lo = temp("gpipe", 16, BASE_M, 1)
+    gb_hi = temp("gpipe", 128, BASE_M, 8)
+    # 1F1B's boundary term grows with batch; GPipe+accum stays ~flat
+    assert fb_hi > fb_lo * 1.5, (fb_lo, fb_hi)
+    assert gb_hi < gb_lo * 1.5, (gb_lo, gb_hi)
+
+
 def test_batch_not_divisible_by_microbatches_raises(devices8):
     mesh = create_mesh(MeshConfig(data=2, pipeline=4))
     params = init_params(jax.random.key(1), MODEL_CFG)

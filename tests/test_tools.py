@@ -7,7 +7,7 @@ from pathlib import Path
 
 import jax
 
-sys.path.insert(0, "tools")
+# tools/ is on sys.path via conftest (anchored at the repo root)
 from compare_loss_csv import main as compare_main  # noqa: E402
 from inspect_checkpoint import main as inspect_main  # noqa: E402
 
