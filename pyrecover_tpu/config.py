@@ -61,8 +61,12 @@ class TrainConfig:
     pp_microbatches: int = 0  # pipeline microbatches; 0 → stage count
     # "gpipe": AD-derived backward wave (composes with everything);
     # "1f1b": explicit interleaved backward — bounds in-flight microbatch
-    # activations per stage to the stage count (parallel/pipeline.py)
-    pp_schedule: str = "gpipe"
+    # activations per stage to the stage count (parallel/pipeline.py).
+    # None = unset: defer to the model config (so an explicit CLI value is
+    # distinguishable from the default and always wins)
+    pp_schedule: Optional[str] = None
+    # interleaved 1F1B chunks per stage (1f1b only); None = defer to model
+    pp_virtual_stages: Optional[int] = None
     loss_chunk_size: int = 0  # >0: fused chunked CE, never materializes full logits
     # -- parallelism ---------------------------------------------------------
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
@@ -117,7 +121,19 @@ class TrainConfig:
             attention_impl=attn,
             remat=self.remat or self.model.remat,
             pp_microbatches=self.pp_microbatches or self.model.pp_microbatches,
-            pp_schedule=self.pp_schedule,
+            # unset (None) defers to a model-set value (presets / test
+            # configs set these on the model directly); an explicit value —
+            # even the default string — wins
+            pp_schedule=(
+                self.pp_schedule
+                if self.pp_schedule is not None
+                else self.model.pp_schedule
+            ),
+            pp_virtual_stages=(
+                self.pp_virtual_stages
+                if self.pp_virtual_stages is not None
+                else self.model.pp_virtual_stages
+            ),
         )
 
 
@@ -216,8 +232,13 @@ def build_parser():
     p.add_argument("--pp-schedule", type=str, default=d.pp_schedule,
                    choices=["gpipe", "1f1b"],
                    help="pipeline training schedule: gpipe (AD backward "
-                        "wave) or 1f1b (interleaved backward; in-flight "
-                        "activations bounded to the stage count)")
+                        "wave, the default) or 1f1b (interleaved backward; "
+                        "in-flight activations bounded to the stage count)")
+    p.add_argument("--pp-virtual-stages", type=int, default=d.pp_virtual_stages,
+                   help="interleaved 1F1B: virtual layer chunks per "
+                        "physical stage (V>1 cuts the pipeline bubble to "
+                        "(S-1)/(V*M+S-1); requires --pp-schedule 1f1b and "
+                        "microbatches divisible by the stage count)")
     p.add_argument("--ep", type=int, default=d.mesh.expert,
                    help="expert-parallel axis size (MoE experts sharded)")
 
@@ -306,6 +327,7 @@ def get_args(argv=None):
                         pipeline=ns.pp, expert=ns.ep),
         pp_microbatches=ns.pp_microbatches,
         pp_schedule=ns.pp_schedule,
+        pp_virtual_stages=ns.pp_virtual_stages,
         distributed=ns.distributed,
         checkpoint_dir=ns.checkpoint_dir,
         checkpoint_frequency=ns.checkpoint_frequency,

@@ -60,6 +60,11 @@ class ModelConfig:
     # "1f1b" (explicit interleaved backward — in-flight microbatches per
     # stage bounded to the stage count; parallel/pipeline.py)
     pp_schedule: str = "gpipe"
+    # virtual (interleaved) stages per physical pipeline stage, 1f1b only:
+    # V > 1 assigns each stage V non-contiguous layer chunks, dropping the
+    # bubble from (S-1)/(M+S-1) to (S-1)/(V·M+S-1) (Megatron-style
+    # interleaving; parallel/pipeline.py::build_interleaved_tables)
+    pp_virtual_stages: int = 1
     remat: bool = False
     # remat policy when remat=True: "full" recomputes everything
     # (nothing_saveable); "save-attn" keeps each block's attention output
@@ -91,6 +96,16 @@ class ModelConfig:
         if self.pp_schedule not in ("gpipe", "1f1b"):
             raise ValueError(
                 f"pp_schedule={self.pp_schedule!r}: expected 'gpipe' or '1f1b'"
+            )
+        if self.pp_virtual_stages < 1:
+            raise ValueError(
+                f"--pp-virtual-stages must be >= 1, got "
+                f"{self.pp_virtual_stages}"
+            )
+        if self.pp_virtual_stages > 1 and self.pp_schedule != "1f1b":
+            raise ValueError(
+                "--pp-virtual-stages > 1 requires --pp-schedule 1f1b (the "
+                "interleaved schedule is a 1F1B variant)"
             )
 
     @property

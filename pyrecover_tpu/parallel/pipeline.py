@@ -395,8 +395,145 @@ def build_1f1b_tables(n_microbatches, n_stages):
             raise RuntimeError("1f1b schedule construction did not converge")
     return np.array(fwd_rows, np.int32), np.array(bwd_rows, np.int32)
 
+@functools.lru_cache(maxsize=None)
+def build_interleaved_tables(n_microbatches, n_stages, n_virtual):
+    """Static (T, S) action tables for INTERLEAVED (virtual-stage) 1F1B.
+
+    Each physical stage holds ``n_virtual`` layer chunks; logical stage
+    ℓ = chunk·S + s runs chunk ``ℓ // S`` on physical stage ``ℓ % S``.
+    Per-stage action SEQUENCES follow the Megatron-LM interleaved
+    schedule (warmup of 2(S−s−1) + (V−1)·S chunk-forwards, then strict
+    forward/backward alternation; forward i touches chunk
+    (i mod S·V)//S of microbatch S·(i div S·V) + i mod S — groups of S
+    microbatches per chunk wave; backwards mirror with chunks reversed),
+    and ticks assign each stage's next action as soon as its dependency
+    (with the one-tick transfer delay) is met. Simulated bubble matches
+    the closed form (S−1)/(V·M+S−1) — vs (S−1)/(M+S−1) non-interleaved.
+
+    Returns ``(fwd_mb, fwd_ck, bwd_mb, bwd_ck, buf_slots)``: four (T, S)
+    int32 tables (-1 = idle) and the ring-buffer slot count (the max
+    in-flight bound over logical stages, from the simulation). Requires
+    ``M % S == 0`` (the Megatron ordering's divisibility contract).
+    """
+    M, S, V = n_microbatches, n_stages, n_virtual
+    if M % S:
+        raise ValueError(
+            f"interleaved 1F1B needs pp_microbatches ({M}) divisible by "
+            f"the stage count ({S})"
+        )
+    SL = S * V
+    total = V * M
+
+    def fwd_action(i):
+        return (i % SL) // S, S * (i // SL) + i % S
+
+    def bwd_action(j):
+        return V - 1 - (j % SL) // S, S * (j // SL) + j % S
+
+    seqs = []
+    for s in range(S):
+        warm = min((S - s - 1) * 2 + (V - 1) * S, total)
+        seq = [("f",) + fwd_action(i) for i in range(warm)]
+        nf, nb = warm, 0
+        while nf < total or nb < total:
+            if nf < total:
+                seq.append(("f",) + fwd_action(nf))
+                nf += 1
+            if nb < total:
+                seq.append(("b",) + bwd_action(nb))
+                nb += 1
+        seqs.append(seq)
+
+    ptr = [0] * S
+    fwd_done, bwd_done = {}, {}
+    fm_rows, fc_rows, bm_rows, bc_rows = [], [], [], []
+    t = 0
+    while any(ptr[s] < len(seqs[s]) for s in range(S)):
+        fm, fc = [-1] * S, [-1] * S
+        bm, bc = [-1] * S, [-1] * S
+        fired = []
+        for s in range(S):
+            if ptr[s] >= len(seqs[s]):
+                continue
+            kind, c, m = seqs[s][ptr[s]]
+            ell = c * S + s
+            if kind == "f":
+                ready = ell == 0 or fwd_done.get((ell - 1, m), t) < t
+                if ready:
+                    fm[s], fc[s] = m, c
+                    fired.append(("f", ell, m, s))
+            else:
+                if ell == SL - 1:
+                    ready = fwd_done.get((ell, m), t) < t
+                else:
+                    ready = bwd_done.get((ell + 1, m), t) < t
+                if ready:
+                    bm[s], bc[s] = m, c
+                    fired.append(("b", ell, m, s))
+        for kind, ell, m, s in fired:
+            (fwd_done if kind == "f" else bwd_done)[(ell, m)] = t
+            ptr[s] += 1
+        fm_rows.append(fm)
+        fc_rows.append(fc)
+        bm_rows.append(bm)
+        bc_rows.append(bc)
+        t += 1
+        if t > 16 * V * (M + S) + 32:
+            raise RuntimeError(
+                "interleaved 1f1b schedule construction did not converge"
+            )
+    # validated invariants: every (logical stage, microbatch) fired exactly
+    # once each way, and the in-flight bound is the ring-buffer size
+    assert len(fwd_done) == len(bwd_done) == SL * M
+    buf_slots = 0
+    for ell in range(SL):
+        events = sorted(
+            [(fwd_done[(ell, m)], 1) for m in range(M)]
+            + [(bwd_done[(ell, m)], -1) for m in range(M)]
+        )
+        cur = peak = 0
+        for _, d in events:
+            cur += d
+            peak = max(peak, cur)
+        buf_slots = max(buf_slots, peak)
+    return (
+        np.array(fm_rows, np.int32), np.array(fc_rows, np.int32),
+        np.array(bm_rows, np.int32), np.array(bc_rows, np.int32),
+        buf_slots,
+    )
+
+
+def interleave_layer_chunks(tree, S, V):
+    """(L, ...) layer-stacked leaves → interleaved order, so a contiguous
+    P(pipeline) split hands physical stage s its V chunks {j·S + s}:
+    position (s, j, c) ← layer (j·S + s)·cl + c, cl = L/(S·V)."""
+    def f(x):
+        cl = x.shape[0] // (S * V)
+        return (
+            x.reshape(V, S, cl, *x.shape[1:])
+            .swapaxes(0, 1)
+            .reshape(S * V * cl, *x.shape[1:])
+        )
+
+    return jax.tree_util.tree_map(f, tree)
+
+
+def uninterleave_layer_chunks(tree, S, V):
+    """Inverse of ``interleave_layer_chunks`` (gradients come back in the
+    interleaved stage order)."""
+    def f(x):
+        cl = x.shape[0] // (S * V)
+        return (
+            x.reshape(S, V, cl, *x.shape[1:])
+            .swapaxes(0, 1)
+            .reshape(S * V * cl, *x.shape[1:])
+        )
+
+    return jax.tree_util.tree_map(f, tree)
+
+
 def pipeline_1f1b_grads(layer_params, x0_mbs, data_mbs, head_params,
-                        block_fn, head_fn, n_microbatches=0):
+                        block_fn, head_fn, n_microbatches=0, n_virtual=1):
     """Run a full fwd+bwd 1F1B pipeline; returns
     ``(loss_sum, extras_sum, d_x0_mbs, d_layers, d_head)``.
 
@@ -418,10 +555,20 @@ def pipeline_1f1b_grads(layer_params, x0_mbs, data_mbs, head_params,
         microbatches; no gradient flows through them).
       block_fn: ``(carry, layer, data_mb) -> carry`` — one block.
       n_microbatches: M; 0 → the stage count.
+      n_virtual: V layer chunks per physical stage (interleaved 1F1B,
+        Megatron-style). V > 1 drops the bubble from (S−1)/(M+S−1) to
+        (S−1)/(V·M+S−1): each stage alternates between its V
+        non-contiguous chunks so pipeline fill/drain happen in chunk
+        units. Costs: chunk boundary crossings ride the full pipeline
+        ring every tick, per-stage saved-input buffers grow to
+        V·buf_slots microbatches, and the boundary queues stay
+        REPLICATED (the v1 rotating-queue optimization applies to V == 1
+        only). Requires M % S == 0 and n_layers % (S·V) == 0.
 
     Gradients are summed over microbatches in f32: identical semantics to
     differentiating the GPipe schedule (equality-tested), different
-    only in schedule — peak in-flight microbatches per stage is S, not M.
+    only in schedule — peak in-flight microbatches per stage is bounded
+    by the static tables (S for V == 1), not M.
     """
     mesh = jax.sharding.get_abstract_mesh()
     S = pipeline_axis_size()
@@ -429,15 +576,27 @@ def pipeline_1f1b_grads(layer_params, x0_mbs, data_mbs, head_params,
     if S <= 1:
         raise ValueError("pipeline_1f1b_grads requires a pipeline axis > 1")
     M = int(n_microbatches) if n_microbatches else S
+    V = max(1, int(n_virtual))
     n_layers = jax.tree_util.tree_leaves(layer_params)[0].shape[0]
-    if n_layers % S:
+    if n_layers % (S * V):
         raise ValueError(
-            f"n_layers={n_layers} not divisible by pipeline stages (--pp) {S}"
+            f"n_layers={n_layers} not divisible by pipeline stages (--pp) "
+            f"{S} x virtual stages (--pp-virtual-stages) {V}"
         )
-    fwd_np, bwd_np = build_1f1b_tables(M, S)
+    if V == 1:
+        fwd_np, bwd_np = build_1f1b_tables(M, S)
+        fck_np = np.where(fwd_np >= 0, 0, -1).astype(np.int32)
+        bck_np = np.where(bwd_np >= 0, 0, -1).astype(np.int32)
+        BUF = S  # live microbatches per stage are consecutive, ≤ S
+    else:
+        fwd_np, fck_np, bwd_np, bck_np, BUF = build_interleaved_tables(
+            M, S, V
+        )
     T = fwd_np.shape[0]
     fwd_tab = jnp.asarray(fwd_np)
     bwd_tab = jnp.asarray(bwd_np)
+    fck_tab = jnp.asarray(fck_np)
+    bck_tab = jnp.asarray(bck_np)
     # Boundary-queue sharding (the x0 inputs and their cotangents): when
     # M % S == 0 each stage holds an (M/S)-slot slice of both queues and
     # the slices rotate over the pipeline ring — the input queue rotates
@@ -451,15 +610,15 @@ def pipeline_1f1b_grads(layer_params, x0_mbs, data_mbs, head_params,
     # where-masked adoption (see the module's collective rules). This
     # removes the last O(M)-replicated term: per-stage boundary memory is
     # 2·(M/S) microbatches instead of 2·M.
-    sharded_io = M % S == 0 and not FORCE_REPLICATED_BUFFERS
+    sharded_io = V == 1 and M % S == 0 and not FORCE_REPLICATED_BUFFERS
     rot_in_tab = jnp.asarray(fwd_np[:, 0] >= 0)
     rot_out_tab = jnp.asarray(bwd_np[:, 0] >= 0)
 
-    def local_stack(c, local_layers, data_mb):
+    def local_stack(c, chunk_layers, data_mb):
         def body(c, layer):
             return block_fn(c, layer, data_mb), None
 
-        out, _ = jax.lax.scan(body, c, local_layers)
+        out, _ = jax.lax.scan(body, c, chunk_layers)
         return out
 
     def stage_program(local_layers, x0_mbs, data_mbs, head_params):
@@ -468,6 +627,17 @@ def pipeline_1f1b_grads(layer_params, x0_mbs, data_mbs, head_params,
         bwd_chain = [(i + 1, i) for i in range(S - 1)]
         ring_fwd = [(i, (i + 1) % S) for i in range(S)]
         ring_back = [(i, (i - 1) % S) for i in range(S)]
+        # V == 1: chain sends (the last/first logical stage sends nothing,
+        # so the wrap edge never carries data). V > 1: chunk transitions
+        # wrap S-1 → 0 (fwd) and 0 → S-1 (bwd), so sends ride the ring
+        # with where-masked adoption.
+        fwd_perm = fwd_chain if V == 1 else ring_fwd
+        bwd_perm = bwd_chain if V == 1 else ring_back
+        # local layer chunks: (V, L/(S·V), ...)
+        local_layers = tmap(
+            lambda l: l.reshape(V, l.shape[0] // V, *l.shape[1:]),
+            local_layers,
+        )
 
         def _pv1(x):
             vma = getattr(jax.typeof(x), "vma", frozenset())
@@ -505,12 +675,17 @@ def pipeline_1f1b_grads(layer_params, x0_mbs, data_mbs, head_params,
             return pvary(tmap(lambda l: jnp.zeros_like(l), carry0))
 
         def buf():
+            # V·BUF flat slots: chunk-major, ring-indexed by microbatch
             return pvary(
-                tmap(lambda l: jnp.zeros((S, *l.shape), l.dtype), carry0)
+                tmap(lambda l: jnp.zeros((V * BUF, *l.shape), l.dtype), carry0)
             )
 
         zero_dlayers = pvary(
             tmap(lambda l: jnp.zeros(l.shape, jnp.float32), local_layers)
+        )
+        # chunk-shaped zero grads for skipped backward ticks
+        zero_dchunk = pvary(
+            tmap(lambda l: jnp.zeros(l.shape[1:], jnp.float32), local_layers)
         )
         # stage 0 records the input-carry cotangents here — each slot is
         # written exactly once (no accumulation), so the buffer stays at
@@ -528,24 +703,27 @@ def pipeline_1f1b_grads(layer_params, x0_mbs, data_mbs, head_params,
             tmap(lambda l: jnp.zeros(l.shape, l.dtype), extras0)
         )
 
-        def read_slot(b, m):
+        def slot(ck, m):
+            # live microbatches per logical stage are consecutive and
+            # bounded by BUF (validated in the table builder), so the
+            # ring index never collides
+            return ck * BUF + m % BUF
+
+        def read_slot(b, idx):
             return tmap(
                 lambda q: jax.lax.dynamic_index_in_dim(
-                    q, m % S, 0, keepdims=False
+                    q, idx, 0, keepdims=False
                 ),
                 b,
             )
 
-        def write_slot(b, m, v, size=S):
-            return tmap(
+        def masked_write(b, idx, v, take):
+            upd = tmap(
                 lambda q, vv: jax.lax.dynamic_update_index_in_dim(
-                    q, vv, m % size, 0
+                    q, vv, idx, 0
                 ),
                 b, v,
             )
-
-        def masked_write(b, m, v, take, size=S):
-            upd = write_slot(b, m, v, size=size)
             return tmap(lambda n, o: jnp.where(take, n, o), upd, b)
 
         def tick(state, t):
@@ -555,33 +733,45 @@ def pipeline_1f1b_grads(layer_params, x0_mbs, data_mbs, head_params,
             bm = bwd_tab[t, s]
             fm_c = jnp.maximum(fm, 0)
             bm_c = jnp.maximum(bm, 0)
+            fck = jnp.maximum(fck_tab[t, s], 0)  # chunk being forwarded
+            bck = jnp.maximum(bck_tab[t, s], 0)  # chunk being backwarded
 
-            # ---- forward (fm >= 0): stage 0 reads its input microbatch,
-            # later stages read the activation received from s-1 ----
+            def chunk_layers(ck):
+                return tmap(
+                    lambda l: jax.lax.dynamic_index_in_dim(
+                        l, ck, 0, keepdims=False
+                    ),
+                    local_layers,
+                )
+
+            # ---- forward (fm >= 0): logical stage 0 (physical 0, chunk
+            # 0) reads its input microbatch, every other logical stage
+            # reads the activation received from its predecessor ----
             def do_fwd(_):
                 x_stage0 = x0_at(x0q, fm_c)
-                x_buf = read_slot(in_buf, fm_c)
+                x_buf = read_slot(in_buf, slot(fck, fm_c))
+                use_x0 = jnp.logical_and(s == 0, fck == 0)
                 x_in = tmap(
-                    lambda a, b: jnp.where(s == 0, a, b), x_stage0, x_buf
+                    lambda a, b: jnp.where(use_x0, a, b), x_stage0, x_buf
                 )
-                y = local_stack(x_in, local_layers, data_at(fm_c))
+                y = local_stack(x_in, chunk_layers(fck), data_at(fm_c))
                 return pvary((x_in, y))
 
             def skip_fwd(_):
                 return zeros_carry(), zeros_carry()
 
             x_in, y_send = jax.lax.cond(fm >= 0, do_fwd, skip_fwd, None)
-            saved_in = masked_write(saved_in, fm_c, x_in, fm >= 0)
+            saved_in = masked_write(saved_in, slot(fck, fm_c), x_in, fm >= 0)
 
             # ---- backward (bm >= 0): recompute-from-input vjp ----
             def do_bwd(_):
-                x_saved = read_slot(saved_in, bm_c)
+                x_saved = read_slot(saved_in, slot(bck, bm_c))
                 data_mb = data_at(bm_c)
 
                 def stack_only(x, layers):
                     return local_stack(x, layers, data_mb)
 
-                yy, svjp = jax.vjp(stack_only, x_saved, local_layers)
+                yy, svjp = jax.vjp(stack_only, x_saved, chunk_layers(bck))
 
                 # the loss head runs ONLY on the last stage (its branch is
                 # collective-free, so the stage-divergent cond is safe) —
@@ -603,13 +793,14 @@ def pipeline_1f1b_grads(layer_params, x0_mbs, data_mbs, head_params,
                     return (zeros_carry(), zero_dhead,
                             _pv1(jnp.float32(0)), zero_extras)
 
-                is_last = s == S - 1
+                # last LOGICAL stage = last physical stage's last chunk
+                is_last = jnp.logical_and(s == S - 1, bck == V - 1)
                 ct_head, dh, mb_loss, mb_extras = jax.lax.cond(
                     is_last, do_head, skip_head, None
                 )
-                # last stage seeds from the loss head; others apply the
-                # received cotangent for this microbatch
-                ct_recv = read_slot(ct_buf, bm_c)
+                # last logical stage seeds from the loss head; others
+                # apply the received cotangent for this microbatch
+                ct_recv = read_slot(ct_buf, slot(bck, bm_c))
                 ct_y = tmap(
                     lambda h, r: jnp.where(is_last, h, r), ct_head, ct_recv
                 )
@@ -617,14 +808,22 @@ def pipeline_1f1b_grads(layer_params, x0_mbs, data_mbs, head_params,
                 return pvary((dx, dl, dh, mb_loss, mb_extras))
 
             def skip_bwd(_):
-                return (zeros_carry(), zero_dlayers, zero_dhead,
+                return (zeros_carry(), zero_dchunk, zero_dhead,
                         _pv1(jnp.float32(0)), zero_extras)
 
             dx_send, dl_delta, dh_delta, mb_loss, mb_extras = jax.lax.cond(
                 bm >= 0, do_bwd, skip_bwd, None
             )
+            # accumulate the chunk's layer grads into its (V, cl, ...) row
+            # (bck clamps to 0 on idle ticks, where dl_delta is zeros)
             dlayers = tmap(
-                lambda a, d: a + d.astype(jnp.float32), dlayers, dl_delta
+                lambda a, d: jax.lax.dynamic_update_index_in_dim(
+                    a,
+                    jax.lax.dynamic_index_in_dim(a, bck, 0, keepdims=False)
+                    + d.astype(jnp.float32),
+                    bck, 0,
+                ),
+                dlayers, dl_delta,
             )
             dhead = tmap(
                 lambda a, d: a + d.astype(jnp.float32), dhead, dh_delta
@@ -632,27 +831,47 @@ def pipeline_1f1b_grads(layer_params, x0_mbs, data_mbs, head_params,
             loss_sum = loss_sum + mb_loss
             extras_sum = tmap(lambda a, d: a + d, extras_sum, mb_extras)
 
-            # stage 0's input cotangent IS this microbatch's d_x0 (the
-            # vjp cotangent already has the carry's dtype)
+            # logical stage 0's input cotangent IS this microbatch's d_x0
+            # (the vjp cotangent already has the carry's dtype)
             dx0 = masked_write(
                 dx0, bm_c // S if sharded_io else bm_c, dx_send,
-                jnp.logical_and(bm >= 0, s == 0),
-                size=M // S if sharded_io else M,
+                jnp.logical_and(
+                    jnp.logical_and(bm >= 0, s == 0), bck == 0
+                ),
             )
 
             # ---- communication: see module comment — results consumed
-            # via jnp.where only ----
-            y_recv = jax.lax.ppermute(y_send, AXIS_PIPE, fwd_chain)
-            ct_recv_new = jax.lax.ppermute(dx_send, AXIS_PIPE, bwd_chain)
-            prev_fm = fwd_tab[t, jnp.maximum(s - 1, 0)]
+            # via jnp.where only. The receiver derives the sender's action
+            # (and with V > 1 the destination CHUNK: a wrap send S-1 → 0
+            # advances the chunk, a wrap send 0 → S-1 lowers it) from the
+            # same static tables. ----
+            y_recv = jax.lax.ppermute(y_send, AXIS_PIPE, fwd_perm)
+            ct_recv_new = jax.lax.ppermute(dx_send, AXIS_PIPE, bwd_perm)
+            sfm = fwd_tab[t, jnp.mod(s - 1, S)]
+            sfc = jnp.maximum(fck_tab[t, jnp.mod(s - 1, S)], 0)
+            if V == 1:
+                adopt_f = jnp.logical_and(s > 0, sfm >= 0)
+                rc_f = jnp.zeros((), jnp.int32)
+            else:
+                adopt_f = jnp.logical_and(
+                    sfm >= 0, jnp.logical_or(s > 0, sfc < V - 1)
+                )
+                rc_f = jnp.clip(jnp.where(s == 0, sfc + 1, sfc), 0, V - 1)
             in_buf = masked_write(
-                in_buf, jnp.maximum(prev_fm, 0), y_recv,
-                jnp.logical_and(s > 0, prev_fm >= 0),
+                in_buf, slot(rc_f, jnp.maximum(sfm, 0)), y_recv, adopt_f
             )
-            next_bm = bwd_tab[t, jnp.minimum(s + 1, S - 1)]
+            sbm = bwd_tab[t, jnp.mod(s + 1, S)]
+            sbc = jnp.maximum(bck_tab[t, jnp.mod(s + 1, S)], 0)
+            if V == 1:
+                adopt_b = jnp.logical_and(s < S - 1, sbm >= 0)
+                rc_b = jnp.zeros((), jnp.int32)
+            else:
+                adopt_b = jnp.logical_and(
+                    sbm >= 0, jnp.logical_or(s < S - 1, sbc > 0)
+                )
+                rc_b = jnp.clip(jnp.where(s == S - 1, sbc - 1, sbc), 0, V - 1)
             ct_buf = masked_write(
-                ct_buf, jnp.maximum(next_bm, 0), ct_recv_new,
-                jnp.logical_and(s < S - 1, next_bm >= 0),
+                ct_buf, slot(rc_b, jnp.maximum(sbm, 0)), ct_recv_new, adopt_b
             )
 
             if sharded_io:
@@ -692,6 +911,11 @@ def pipeline_1f1b_grads(layer_params, x0_mbs, data_mbs, head_params,
                 dx0,
             )
         dhead = tmap(lambda x: jax.lax.psum(x, AXIS_PIPE), dhead)
+        # flatten the per-chunk grads back to the stage's (V·cl, ...) slice
+        dlayers = tmap(
+            lambda l: l.reshape(l.shape[0] * l.shape[1], *l.shape[2:]),
+            dlayers,
+        )
         return loss_sum, extras_sum, dx0, dlayers, dhead
 
     from pyrecover_tpu.parallel.mesh import constraints_disabled
@@ -707,6 +931,10 @@ def pipeline_1f1b_grads(layer_params, x0_mbs, data_mbs, head_params,
     else:
         x0_in = x0_mbs
         x0_spec = dx0_spec = P()
+    layers_in = (
+        layer_params if V == 1
+        else interleave_layer_chunks(layer_params, S, V)
+    )
 
     with constraints_disabled():
         loss_sum, extras_sum, dx0, dlayers, dhead = jax.shard_map(
@@ -715,7 +943,9 @@ def pipeline_1f1b_grads(layer_params, x0_mbs, data_mbs, head_params,
             in_specs=(P(AXIS_PIPE), x0_spec, P(), P()),
             out_specs=(P(), P(), dx0_spec, P(AXIS_PIPE), P()),
             axis_names={AXIS_PIPE},
-        )(layer_params, x0_in, data_mbs, head_params)
+        )(layers_in, x0_in, data_mbs, head_params)
     if sharded_io:
         dx0 = uninterleave_rows(dx0, M, S)
+    if V > 1:
+        dlayers = uninterleave_layer_chunks(dlayers, S, V)
     return loss_sum, extras_sum, dx0, dlayers, dhead

@@ -226,6 +226,7 @@ def _pipelined_1f1b_value_and_grad(params, batch, model_config,
     _, (ce_total, aux_total), dx0_mbs, dlayers, dhead = pipeline_1f1b_grads(
         params["layers"], x0_mbs, data_mbs, head_params,
         block_fn, head_fn, n_microbatches=M,
+        n_virtual=cfg.pp_virtual_stages,
     )
     (dembed,) = embed_vjp(
         jax.tree_util.tree_map(
