@@ -17,13 +17,14 @@ OUT=BENCH_r05_raw.jsonl
 LOG=tools/bench_campaign.log
 touch "$OUT"
 
-TAGS=(moe-grouped moe-scatter moe-einsum headline seq8192)
+TAGS=(moe-grouped moe-scatter moe-einsum headline seq8192 packed-ab)
 CMDS=(
   "python bench.py --model moe-4x1b --seq-len 1024 --batch-size 4 --moe-dispatch grouped --skip-ckpt --steps 10"
   "python bench.py --model moe-4x1b --seq-len 1024 --batch-size 4 --moe-dispatch scatter --skip-ckpt --steps 10"
   "python bench.py --model moe-4x1b --seq-len 1024 --batch-size 4 --moe-dispatch einsum --skip-ckpt --steps 10"
   "python bench.py --steps 10"
   "python bench.py --seq-len 8192 --batch-size 2 --skip-ckpt --steps 5"
+  "python tools/bench_packed.py --steps 20"
 )
 
 log() { echo "$(date -u +%FT%TZ) $*" >> "$LOG"; }
