@@ -36,18 +36,27 @@ WORDS = [
 
 
 def build_corpus(root, n_docs, mean_words, seed=0):
-    """Deterministic variable-length corpus + word-level tokenizer dir."""
+    """Deterministic variable-length corpus + word-level tokenizer dir.
+
+    The cache is keyed on the corpus parameters (a per-params subdir) and
+    validated by a DONE marker written LAST — a mid-write kill (the
+    campaign runs this under `timeout`) leaves no marker, so the torn
+    cache is wiped and rebuilt instead of wedging every retry."""
+    import shutil
+
     import numpy as np
     import pyarrow as pa
     import pyarrow.parquet as pq
     from tokenizers import Tokenizer, models, pre_tokenizers
     from transformers import PreTrainedTokenizerFast
 
-    root = Path(root)
+    root = Path(root) / f"d{n_docs}_w{mean_words}_s{seed}"
     corpus = root / "corpus.parquet"
     tok_dir = root / "tokenizer"
-    if corpus.exists() and (tok_dir / "tokenizer.json").exists():
+    done = root / "DONE"
+    if done.exists():
         return corpus, tok_dir
+    shutil.rmtree(root, ignore_errors=True)  # torn partial build, if any
     root.mkdir(parents=True, exist_ok=True)
     rng = np.random.default_rng(seed)
     # lognormal-ish length mix: plenty of short docs (the padding waste the
@@ -70,6 +79,7 @@ def build_corpus(root, n_docs, mean_words, seed=0):
         tokenizer_object=tok, pad_token="[PAD]", unk_token="[UNK]",
         eos_token="[EOS]",
     ).save_pretrained(tok_dir)
+    done.write_text("ok")  # marker LAST: its presence == complete build
     return corpus, tok_dir
 
 
@@ -95,15 +105,14 @@ def run_variant(corpus, tok_dir, *, packed, steps, seq_len, batch, workdir):
             checkpoint_dir=str(workdir), checkpoint_frequency=-1,
             experiment_name="pack_ab", logging_frequency=5,
             use_flash_attention=jax_platform() != "cpu",
+            # all-bf16 like bench.py's headline rows — set on the
+            # TrainConfig (its __post_init__ would clobber a model-level
+            # dtype override)
+            model_dtype="bf16", param_dtype="bf16",
         )
         from pyrecover_tpu.models import presets
 
         cfg.model = presets.llama_150m(max_seq_len=seq_len)
-        import dataclasses
-
-        cfg.model = dataclasses.replace(
-            cfg.model, param_dtype="bfloat16", compute_dtype="bfloat16"
-        )
         cfg.__post_init__()
         train(cfg)
     finally:
