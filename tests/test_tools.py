@@ -143,6 +143,18 @@ def test_generate_from_checkpoint(tmp_path):
     out3 = subprocess.run(args + ["--temperature", "1.0"], capture_output=True,
                           text=True, timeout=300, env=env)
     assert out3.returncode == 0, out3.stderr[-2000:]
+    # batched prompts (';'-separated): one line per prompt, row 0 equals
+    # the single-prompt greedy output (lockstep decode through one cache)
+    batched = [
+        a if a != "1,2,3" else "1,2,3;7,5,9" for a in args
+    ]
+    out4 = subprocess.run(batched, capture_output=True, text=True,
+                          timeout=300, env=env)
+    assert out4.returncode == 0, out4.stderr[-2000:]
+    lines = out4.stdout.strip().splitlines()
+    assert len(lines) == 2
+    assert lines[0] == out1.stdout.strip()
+    assert lines[1].startswith("7,5,9,") and len(lines[1].split(",")) == 8
 
 
 def test_inspect_diagnoses_corrupt_checkpoint(tmp_path, capsys):

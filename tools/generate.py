@@ -68,32 +68,37 @@ def load_params(path, model_cfg):
     return params, model_cfg
 
 
-def generate(params, model_cfg, prompt_ids, max_new_tokens, temperature, seed):
+def generate(params, model_cfg, rows, max_new_tokens, temperature, seed):
+    """``rows``: a validated list of one-or-more EQUAL-length prompt rows
+    (the caller normalizes/validates — a batch decodes in lockstep through
+    one cache, one model pass per token regardless of batch size).
+    Returns a list of output rows, one per prompt."""
     from pyrecover_tpu.models.decode import generate_tokens
 
     # the cache covers max_seq_len positions; the library API raises on
     # overflow, but the CLI clamps like the old sliding-window behavior:
     # keep the prompt TAIL and cap the new-token budget, with a warning
     L = model_cfg.max_seq_len
-    prompt_ids = list(prompt_ids)
     max_new_tokens = int(max_new_tokens)
-    dropped_prefix = []
     if max_new_tokens >= L:
         print(f"warning: --max-new-tokens capped to {L - 1} "
               f"(max-seq-len {L})", file=sys.stderr)
         max_new_tokens = L - 1
-    if len(prompt_ids) + max_new_tokens > L:
+    dropped = [[] for _ in rows]
+    if len(rows[0]) + max_new_tokens > L:
         keep = L - max_new_tokens
-        dropped_prefix = prompt_ids[:-keep]
+        dropped = [r[:-keep] for r in rows]
         print(f"warning: prompt truncated to its last {keep} tokens to fit "
               f"max-seq-len {L} with {max_new_tokens} new tokens",
               file=sys.stderr)
-        prompt_ids = prompt_ids[-keep:]
+        rows = [r[-keep:] for r in rows]
     out = generate_tokens(
-        params, model_cfg, prompt_ids, max_new_tokens,
-        temperature=temperature, seed=seed,
+        params, model_cfg, rows if len(rows) > 1 else rows[0],
+        max_new_tokens, temperature=temperature, seed=seed,
     )
-    return dropped_prefix + out
+    if len(rows) == 1:
+        out = [out]
+    return [d + o for d, o in zip(dropped, out)]
 
 
 def main(argv=None):
@@ -112,7 +117,9 @@ def main(argv=None):
     ap.add_argument("--max-seq-len", type=int, default=0)
     ap.add_argument("--multiple-of", type=int, default=0)
     ap.add_argument("--prompt-ids", default="1",
-                    help="comma-separated token ids")
+                    help="comma-separated token ids; ';' separates a BATCH "
+                         "of equal-length prompts decoded in lockstep "
+                         "(one output line per prompt)")
     ap.add_argument("--prompt", default="",
                     help="text prompt (requires --tokenizer)")
     ap.add_argument("--tokenizer", default="",
@@ -159,17 +166,29 @@ def main(argv=None):
             if tokenizer is None:
                 print("--prompt requires --tokenizer", file=sys.stderr)
                 return 2
-            prompt_ids = tokenizer(args.prompt)["input_ids"]
+            rows = [tokenizer(args.prompt)["input_ids"]]
         else:
-            prompt_ids = [int(x) for x in args.prompt_ids.split(",")]
+            groups = [g for g in args.prompt_ids.split(";") if g]
+            rows = [[int(x) for x in g.split(",") if x] for g in groups]
+        # validate HERE, before the tail-truncation could silently equalize
+        # a ragged batch the library would have rejected loudly
+        if not rows or any(not r for r in rows):
+            print("error: every prompt needs at least one token id",
+                  file=sys.stderr)
+            return 2
+        if any(len(r) != len(rows[0]) for r in rows):
+            print("error: batched prompts must be EQUAL length "
+                  f"(got {[len(r) for r in rows]})", file=sys.stderr)
+            return 2
 
         params, cfg = load_params(args.checkpoint, cfg)
-        ids = generate(params, cfg, prompt_ids, args.max_new_tokens,
-                       args.temperature, args.seed)
-        if tokenizer is not None:
-            print(tokenizer.decode(ids))
-        else:
-            print(",".join(str(i) for i in ids))
+        out_rows = generate(params, cfg, rows, args.max_new_tokens,
+                            args.temperature, args.seed)
+        for row in out_rows:
+            if tokenizer is not None:
+                print(tokenizer.decode(row))
+            else:
+                print(",".join(str(i) for i in row))
         return 0
     except Exception as e:  # tool: fail with a message, not a traceback wall
         print(f"error: {e}", file=sys.stderr)
