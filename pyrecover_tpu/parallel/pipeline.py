@@ -560,10 +560,10 @@ def pipeline_1f1b_grads(layer_params, x0_mbs, data_mbs, head_params,
         (S−1)/(V·M+S−1): each stage alternates between its V
         non-contiguous chunks so pipeline fill/drain happen in chunk
         units. Costs: chunk boundary crossings ride the full pipeline
-        ring every tick, per-stage saved-input buffers grow to
-        V·buf_slots microbatches, and the boundary queues stay
-        REPLICATED (the v1 rotating-queue optimization applies to V == 1
-        only). Requires M % S == 0 and n_layers % (S·V) == 0.
+        ring every tick, and per-stage saved-input buffers grow to
+        V·buf_slots microbatches. The rotating sharded boundary queues
+        still apply (rotations key on stage-0 chunk-0 events). Requires
+        M % S == 0 and n_layers % (S·V) == 0.
 
     Gradients are summed over microbatches in f32: identical semantics to
     differentiating the GPipe schedule (equality-tested), different
@@ -610,9 +610,15 @@ def pipeline_1f1b_grads(layer_params, x0_mbs, data_mbs, head_params,
     # where-masked adoption (see the module's collective rules). This
     # removes the last O(M)-replicated term: per-stage boundary memory is
     # 2·(M/S) microbatches instead of 2·M.
-    sharded_io = V == 1 and M % S == 0 and not FORCE_REPLICATED_BUFFERS
-    rot_in_tab = jnp.asarray(fwd_np[:, 0] >= 0)
-    rot_out_tab = jnp.asarray(bwd_np[:, 0] >= 0)
+    # The rotating boundary queues generalize to interleaved 1F1B: the
+    # queues only serve LOGICAL stage 0 (physical 0, chunk 0), whose
+    # forward/backward orders are m-increasing in the Megatron sequences
+    # exactly as in plain 1F1B — so rotations keyed on stage-0 CHUNK-0
+    # events preserve the v1 invariants (microbatch m under stage 0 after
+    # m input rotations; dx0 landing at the uninterleave_rows permutation).
+    sharded_io = M % S == 0 and not FORCE_REPLICATED_BUFFERS
+    rot_in_tab = jnp.asarray((fwd_np[:, 0] >= 0) & (fck_np[:, 0] == 0))
+    rot_out_tab = jnp.asarray((bwd_np[:, 0] >= 0) & (bck_np[:, 0] == 0))
 
     def local_stack(c, chunk_layers, data_mb):
         def body(c, layer):
