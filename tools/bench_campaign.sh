@@ -54,10 +54,12 @@ while ! all_done; do
       log "row $t produced no output (hang/timeout); breaking to re-probe"
       break
     fi
-    echo "$line" | python - "$t" <<'PYEOF' >> "$OUT" 2>>"$LOG"
+    # NOTE: the JSON line rides argv — a heredoc would REPLACE a stdin
+    # pipe ( `echo | python - <<EOF` feeds python the heredoc as the
+    # program and empty stdin), silently breaking the recorder
+    python - "$t" "$line" <<'PYEOF' >> "$OUT" 2>>"$LOG"
 import json, sys
-line = sys.stdin.read().strip()
-tag = sys.argv[1]
+tag, line = sys.argv[1], sys.argv[2]
 try:
     d = json.loads(line)
 except Exception:
