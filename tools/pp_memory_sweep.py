@@ -2,7 +2,7 @@
 
 `make_train_step` rejects grad accumulation under the 1F1B schedule with
 "raise --pp-microbatches instead" (train_state.py) — 1F1B's microbatches
-ARE the accumulation. This sweep quantifies that guidance in BOTH
+ARE the accumulation. This sweep quantifies that guidance in THREE
 regimes, on the virtual CPU mesh via XLA's compiled `memory_analysis`
 (the same measurement `tests/test_pipeline.py::
 test_1f1b_reduces_peak_memory_remat_off` pins):
@@ -15,6 +15,9 @@ test_1f1b_reduces_peak_memory_remat_off` pins):
      passes (GPipe at fixed M0): here 1F1B's boundary bytes DO grow
      linearly with the batch while GPipe+accum's pipeline stays
      constant-size — the regime where a crossover can exist.
+  C. interleaving cost: plain 1F1B vs --pp-virtual-stages V at fixed
+     batch — bubble halves by construction and per-tick vjp transients
+     shrink with the 1/V chunk size.
 
 Run:
   env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
@@ -41,6 +44,7 @@ from pyrecover_tpu.train_state import make_train_step
 SEQ = 32
 STAGES = 4
 BASE_M = 8  # GPipe's fixed pipeline depth; accumulation provides the rest
+VIRTUAL = 2  # regime C's interleaving factor (--pp-virtual-stages)
 
 
 def measure(mesh, model_cfg, batch, accum):
@@ -124,8 +128,46 @@ def main():
         (f"B{16 * s}/M{BASE_M * s}", 16 * s, BASE_M * s, s)
         for s in (1, 2, 4, 8)
     ])
+    print()
+    print(f"Regime C — interleaving cost: plain 1F1B vs --pp-virtual-stages "
+          f"{VIRTUAL} ({STAGES * VIRTUAL} layers so chunks divide; fixed "
+          "batch 64):")
+    base_c = dataclasses.replace(base, n_layers=STAGES * VIRTUAL)
+    rows_c = []
+    for m in (8, 16, 32):
+        v1 = measure(
+            mesh,
+            dataclasses.replace(base_c, pp_microbatches=m, pp_schedule="1f1b"),
+            64, accum=1,
+        )
+        v2 = measure(
+            mesh,
+            dataclasses.replace(
+                base_c, pp_microbatches=m, pp_schedule="1f1b",
+                pp_virtual_stages=VIRTUAL,
+            ),
+            64, accum=1,
+        )
+        rows_c.append({
+            "M": m, "temp_v1_mb": round(v1 / 1e6, 2),
+            "temp_v2_mb": round(v2 / 1e6, 2),
+            "ratio_v2_over_v1": round(v2 / v1, 3),
+            "bubble_v1": round((STAGES - 1) / (m + STAGES - 1), 3),
+            "bubble_v2": round(
+                (STAGES - 1) / (VIRTUAL * m + STAGES - 1), 3
+            ),
+        })
+    print("| M | V=1 temp MB | V=2 temp MB | ratio | bubble V=1 → V=2 |")
+    print("|---|---|---|---|---|")
+    for r in rows_c:
+        print(
+            f"| {r['M']} | {r['temp_v1_mb']} | {r['temp_v2_mb']} "
+            f"| {r['ratio_v2_over_v1']} "
+            f"| {r['bubble_v1']} → {r['bubble_v2']} |"
+        )
     print(json.dumps({"stages": STAGES, "base_m": BASE_M,
-                      "regime_a": rows_a, "regime_b": rows_b}))
+                      "regime_a": rows_a, "regime_b": rows_b,
+                      "regime_c": rows_c}))
 
 
 if __name__ == "__main__":
