@@ -279,6 +279,76 @@ def test_interleaved_tables_cut_the_bubble():
             assert len(seen) == S * v * M
 
 
+@pytest.mark.parametrize(
+    "S,V,M",
+    [(2, 2, 4), (4, 2, 8), (4, 2, 16), (2, 4, 8), (4, 4, 16), (3, 2, 6),
+     (4, 1, 8)],
+)
+def test_interleaved_queue_rotation_invariants(S, V, M):
+    """Pure-numpy replay of the boundary-queue mechanism against the
+    static tables, at shapes the e2e equality tests can't affordably
+    cover: (a) every fwd/bwd dependency is satisfied with the one-tick
+    transfer delay; (b) the rotating INPUT queue holds microbatch m at
+    stage-0 slot m//S exactly when stage 0 forwards chunk 0 of m; (c)
+    the dx0 queue's rotations land every cotangent at the
+    uninterleave_rows home row."""
+    from pyrecover_tpu.parallel.pipeline import (
+        build_1f1b_tables,
+        build_interleaved_tables,
+    )
+
+    if V == 1:
+        fm, bm = build_1f1b_tables(M, S)
+        fc = np.where(fm >= 0, 0, -1).astype(np.int32)
+        bc = np.where(bm >= 0, 0, -1).astype(np.int32)
+    else:
+        fm, fc, bm, bc, _ = build_interleaved_tables(M, S, V)
+    T = fm.shape[0]
+
+    # (a) dependencies with the one-tick delay
+    fdone, bdone = {}, {}
+    for t in range(T):
+        for s in range(S):
+            if fm[t, s] >= 0:
+                ell, m = fc[t, s] * S + s, int(fm[t, s])
+                if ell > 0:
+                    assert fdone[(ell - 1, m)] < t, (t, s, ell, m)
+                fdone[(ell, m)] = t
+            if bm[t, s] >= 0:
+                ell, m = bc[t, s] * S + s, int(bm[t, s])
+                if ell == S * V - 1:
+                    assert fdone[(ell, m)] < t
+                else:
+                    assert bdone[(ell + 1, m)] < t
+                bdone[(ell, m)] = t
+    assert len(fdone) == len(bdone) == S * V * M
+
+    # (b) input queue: interleave_queue layout, ppermute toward stage 0 on
+    # every stage-0 chunk-0 FORWARD tick (rotation follows the read within
+    # the tick body)
+    rows_per = M // S
+    q = np.array([[j * S + s for j in range(rows_per)] for s in range(S)])
+    for t in range(T):
+        if fm[t, 0] >= 0 and fc[t, 0] == 0:
+            m = int(fm[t, 0])
+            assert q[0, m // S] == m, f"t={t}: stage-0 slot holds {q[0, m//S]}"
+            q = np.roll(q, -1, axis=0)  # rotate toward stage 0 after the read
+
+    # (c) dx0 queue: write at stage-0 slot m//S on its chunk-0 BACKWARD
+    # tick, rotate away from stage 0 the same tick; final home row is the
+    # uninterleave_rows permutation
+    dq = np.full((S, rows_per), -1)
+    for t in range(T):
+        if bm[t, 0] >= 0 and bc[t, 0] == 0:
+            m = int(bm[t, 0])
+            assert dq[0, m // S] == -1, "cotangent slot clobbered"
+            dq[0, m // S] = m
+            dq = np.roll(dq, 1, axis=0)  # ring away from stage 0
+    for m in range(M):
+        home = (-m) % S
+        assert dq[home, m // S] == m, (m, dq)
+
+
 def test_interleaved_1f1b_guards(devices8):
     from pyrecover_tpu.parallel.pipeline import build_interleaved_tables
 
