@@ -29,17 +29,21 @@ the aux loss is the Switch load-balance loss ``E · Σ_e f_e·p_e`` per row.
 
 Four dispatch backends share these semantics (pinned equal by tests):
 
-  * ``_moe_ffn_grouped`` — the MXU path: each row's (token, slot) picks are
-    sorted by expert and the expert FFNs run as ragged grouped matmuls
-    (``jax.lax.ragged_dot_general``) over contiguous expert groups. No
-    capacity-padded slot tensor, no scatter serialization — the MXU sees
-    one dense GEMM per expert sized by its actual load. Default wherever
-    the expert axis is unsharded.
-  * ``_moe_ffn_grouped_ep`` — the MXU path composed with expert sharding:
-    an explicitly-SPMD shard_map where each expert shard ragged-GEMMs only
-    its local experts' picks (static bound E_loc·C rows) and one psum over
-    (expert, tensor) plays both the combine exchange and the row-parallel
-    reduction. Selected by ``moe_dispatch='grouped'`` with ep > 1.
+  * ``_moe_ffn_grouped`` — the MXU path: ALL (token, slot) picks are
+    flattened into one pool, sorted by expert, and the expert FFNs run as
+    ragged grouped matmuls (``jax.lax.ragged_dot``, whose 2-D lhs is the
+    one form TPU's native ragged-dot lowering accepts) over contiguous
+    expert groups. No capacity-padded slot tensor, no scatter
+    serialization — the MXU sees one dense GEMM per expert sized by its
+    actual load. Default when batch and expert axes are both unsharded
+    (the flat sort is batch-global, so a sharded batch would gather).
+  * ``_moe_ffn_grouped_ep`` — the MXU path composed with sharding: an
+    explicitly-SPMD shard_map where each shard flat-sorts its LOCAL batch
+    rows, ragged-GEMMs only its local experts' picks (static bound
+    B_loc·E_loc·C rows) and one psum over (expert, tensor) plays both the
+    combine exchange and the row-parallel reduction. Selected for
+    ``moe_dispatch='grouped'`` whenever the batch or expert axis is
+    sharded (ep ≥ 1), and by ``auto`` for sharded-batch ep == 1 meshes.
   * ``_moe_ffn_impl`` (rank-and-scatter) — the default EP path: static
     (B,E,C,D) dispatch whose ``expert``-axis constrain turns into
     all-to-alls.
@@ -148,23 +152,37 @@ def moe_ffn(h, router_w, w1, w3, w2, config):
             # dispatch there: expressible entirely as einsums, compiles
             # everywhere, numerically pinned to the scatter path by tests.
             return _moe_ffn_einsum(h, router_w, w1, w3, w2, config)
-    ep = 1
+    ep = batch_shards = sp = 1
     if mesh is not None and not mesh.empty:
         ep = mesh.shape.get(AXIS_EXPERT, 1)
+        batch_shards = mesh.shape.get(AXIS_DATA, 1) * mesh.shape.get(
+            AXIS_FSDP, 1
+        )
+        sp = mesh.shape.get(AXIS_SEQ, 1)
     choice = config.moe_dispatch
     if choice == "auto" and ep == 1:
         # Grouped ragged GEMMs whenever the expert axis is unsharded: the
-        # per-row sort/gather keeps data/fsdp sharding intact, and the
         # expert FFNs run as dense per-expert matmuls on the MXU — built to
-        # close the 34.5%-active-MFU shortfall BENCH_r03 exposed (projected
-        # from the dispatch-cost model; equivalence-tested, awaiting an
-        # on-chip A/B via `bench.py --moe-dispatch`). With ep > 1 the auto
-        # pick stays with the scatter/einsum forms until the explicitly-
-        # SPMD grouped path below is measured on chip.
-        return _moe_ffn_grouped(h, router_w, w1, w3, w2, config)
-    if choice == "grouped":
-        if ep > 1:
+        # close the 34.5%-active-MFU shortfall BENCH_r03 exposed. The flat
+        # sort is batch-global, so on a sharded batch the shard-local
+        # manual form is used instead (same math, sort/gather stay on-
+        # shard; ep=1 degenerates its expert split away) — EXCEPT under
+        # sequence sharding, which that form cannot express (it would
+        # un-shard the activations): there the scatter/einsum choice below
+        # keeps sp intact. With ep > 1 the auto pick also stays with
+        # scatter/einsum until grouped-EP is measured on real multichip.
+        if batch_shards == 1 and sp == 1:
+            return _moe_ffn_grouped(h, router_w, w1, w3, w2, config)
+        if sp == 1:
             return _moe_ffn_grouped_ep(h, router_w, w1, w3, w2, config, mesh)
+        # sp > 1 falls through: both grouped forms would gather the
+        # seq-sharded activations their flat sort flattens over
+    if choice == "grouped":
+        if ep > 1 or (batch_shards > 1 and sp == 1):
+            return _moe_ffn_grouped_ep(h, router_w, w1, w3, w2, config, mesh)
+        # fully-local mesh — or sp > 1 with ep == 1, where the manual form
+        # is inexpressible and the batch-global sort's gathers are the
+        # price of an explicit 'grouped' request under sequence sharding
         return _moe_ffn_grouped(h, router_w, w1, w3, w2, config)
     if choice == "auto":
         # Measured on v5e (8x150m, S=1024, fwd+bwd per MoE layer): einsum
@@ -237,60 +255,92 @@ def _moe_ffn_impl(h, router_w, w1, w3, w2, config):
     return y.astype(h.dtype), _switch_aux(probs, onehot, E, N)
 
 
+def _flat_pick_sort(h2d, ids_flat, keep_flat, M_cap, N, S, K, cdt):
+    """Shared dispatch front half of both grouped backends: stably sort the
+    flattened (rows·N,) pick pool by group id, gather each pick's token row
+    from the flattened (rows·S, D) activations (flat pick m = (row m // N,
+    slot m % N) → token row (m // N)·S + (m % N)//K), truncate to the
+    static bound ``M_cap``, and zero picks whose keep flag is off. One
+    definition keeps the grouped backends' pinned equality structural
+    (the same principle as ``_route``). Returns ``(x, order)`` with ``x``
+    (M_cap, D) in group-sorted order and ``order`` the full (rows·N,)
+    permutation (``_flat_pick_combine`` inverts it)."""
+    order = jnp.argsort(ids_flat, stable=True)
+    order_c = order[:M_cap]
+    tok = (order_c // N) * S + (order_c % N) // K
+    x = jnp.take(h2d, tok, axis=0)
+    keep = jnp.take(keep_flat, order_c)
+    return x * keep[:, None].astype(cdt), order
+
+
+def _flat_pick_combine(out, order, wgt, rows, S, K, cdt):
+    """Shared combine back half: pad the (M_cap, D) group-sorted expert
+    outputs back to the full pool length (truncated picks land in the zero
+    padding), invert the sort permutation, weight each pick by its gate
+    (zeroed for dropped/non-local picks), and sum the K picks per token."""
+    D = out.shape[-1]
+    Ml = order.shape[0]
+    if out.shape[0] < Ml:
+        out = jnp.pad(out, ((0, Ml - out.shape[0]), (0, 0)))
+    y_picks = jnp.take(out, jnp.argsort(order), axis=0)  # flat pick order
+    return jnp.sum(
+        y_picks.reshape(rows, S, K, D) * wgt.reshape(rows, S, K, 1), axis=2
+    )
+
+
 def _moe_ffn_grouped(h, router_w, w1, w3, w2, config):
     """Grouped-GEMM dispatch: expert-sorted tokens through ragged matmuls.
 
-    Each row's N = S·K (token, slot) picks are stably argsorted by expert
-    id, giving contiguous per-expert runs whose lengths (the pre-capacity
-    routing histogram) are the ragged ``group_sizes``. The three expert
-    projections then run as ``jax.lax.ragged_dot_general`` calls — one
-    dense MXU GEMM per expert, sized by that expert's actual load, with no
-    (B,E,C,D) capacity padding and no serializing scatters. Dropped picks
-    (rank ≥ C) keep their sorted position but are zeroed: a zero row
-    through SwiGLU is exactly zero (silu(0)·0 = 0), and their gate weight
-    is zeroed in the combine, so semantics stay identical to the other
-    backends (equality-pinned by tests). Everything is per-row, so batch
-    sharding over data/fsdp passes through untouched; expert-sharded
-    meshes (ep > 1) use the scatter/einsum backends instead, whose
-    dispatch constrain is what produces the expert all-to-alls.
+    ALL B·S·K (token, slot) picks are flattened into one pool and stably
+    argsorted by expert id, giving contiguous per-expert runs whose
+    lengths (the batch-global pre-capacity routing histogram) are the
+    ragged ``group_sizes``. The three expert projections then run as
+    ``jax.lax.ragged_dot`` calls — one dense MXU GEMM per expert, sized by
+    that expert's actual load, with no (B,E,C,D) capacity padding and no
+    serializing scatters. The lhs is 2-D ``(B·N, D)`` BY REQUIREMENT, not
+    style: TPU's native ragged-dot lowering (RaggedConvSpec) accepts
+    exactly one lhs non-contracting dimension — the rank-3 per-row form
+    with (B,E) group sizes runs on the CPU backend but fails TPU
+    compilation ("number of lhs non-contracting dimensions should be 1,
+    got 2"; first seen on-chip in the round-5 bench campaign). Flattening
+    also feeds the MXU B×-larger per-expert GEMMs. Dropped picks (rank ≥
+    C, still per-row FCFS capacity — routing semantics are unchanged) keep
+    their sorted position but are zeroed: a zero row through SwiGLU is
+    exactly zero (silu(0)·0 = 0), and their gate weight is zeroed in the
+    combine, so semantics stay identical to the other backends
+    (equality-pinned by tests). The batch-global sort mixes rows, so under
+    a data/fsdp-sharded batch GSPMD inserts gathers across the batch
+    shards — the auto pick therefore prefers this path on unsharded-batch
+    meshes and per-device-batch regimes; expert-sharded meshes use
+    ``_moe_ffn_grouped_ep``, whose sort is shard-local by construction.
     """
     cfg = config
     B, S, D = h.shape
     E, K = cfg.n_experts, cfg.moe_top_k
     C = moe_capacity(S, E, K, cfg.moe_capacity_factor)
     N = S * K
+    M = B * N
 
     probs, eids, gvals, onehot, rank, valid = _route(h, router_w, E, K, C)
 
-    # --- expert-sort each row's picks; group sizes = routing histogram
-    # (pre-capacity: overflow picks stay in their group as zero rows, so
-    # the sizes sum to N exactly) ---
+    # --- expert-sort the flattened pick pool; group sizes = batch-global
+    # routing histogram (pre-capacity: overflow picks stay in their group
+    # as zero rows, so the sizes sum to M exactly) ---
     cdt = h.dtype
-    order = jnp.argsort(eids, axis=1, stable=True)  # (B,N) pick ids by expert
-    tok_sorted = order // K  # pick n came from token n // K
-    x = jnp.take_along_axis(h, tok_sorted[..., None], axis=1)  # (B,N,D)
-    valid_sorted = jnp.take_along_axis(valid, order, axis=1)
-    x = x * valid_sorted[..., None].astype(cdt)
-    group_sizes = jnp.sum(onehot, axis=1).astype(jnp.int32)  # (B,E)
+    x, order = _flat_pick_sort(
+        h.reshape(B * S, D), eids.reshape(M), valid.reshape(M), M, N, S, K, cdt
+    )  # (M, D) in expert-sorted order
+    group_sizes = jnp.sum(onehot, axis=(0, 1)).astype(jnp.int32)  # (E,)
 
-    rdn = jax.lax.RaggedDotDimensionNumbers(
-        dot_dimension_numbers=(((2,), (1,)), ((), ())),
-        lhs_ragged_dimensions=[1],
-        rhs_group_dimensions=[0],
-    )
-    gate = jax.nn.silu(
-        jax.lax.ragged_dot_general(x, w1.astype(cdt), group_sizes, rdn)
-    )
-    up = jax.lax.ragged_dot_general(x, w3.astype(cdt), group_sizes, rdn)
-    out = jax.lax.ragged_dot_general(
-        gate * up, w2.astype(cdt), group_sizes, rdn
-    )  # (B,N,D), still in expert-sorted order
+    gate = jax.nn.silu(jax.lax.ragged_dot(x, w1.astype(cdt), group_sizes))
+    up = jax.lax.ragged_dot(x, w3.astype(cdt), group_sizes)
+    out = jax.lax.ragged_dot(
+        gate * up, w2.astype(cdt), group_sizes
+    )  # (M, D), still in expert-sorted order
 
     # --- unsort and combine with renormalized gates ---
-    inv = jnp.argsort(order, axis=1)  # inverse permutation
-    y_picks = jnp.take_along_axis(out, inv[..., None], axis=1)  # pick order
     w = jnp.where(valid, gvals, 0.0).astype(cdt)
-    y = jnp.sum((y_picks * w[..., None]).reshape(B, S, K, D), axis=2)
+    y = _flat_pick_combine(out, order, w, B, S, K, cdt)
 
     return y.astype(h.dtype), _switch_aux(probs, onehot, E, N)
 
@@ -310,7 +360,7 @@ def _moe_ffn_grouped_ep(h, router_w, w1, w3, w2, config, mesh):
     the disjoint per-shard partial outputs — each valid pick contributes on
     exactly one expert shard. The exchange all-to-all and the combine
     reduction collapse into that single psum; compute per shard is bounded
-    by the static slice N_cap = E_loc·C rows (the capacity bound), so EP
+    by the static slice M_cap = B_loc·E_loc·C rows (the capacity bound), so EP
     divides the expert FLOPs by ep exactly like the scatter path's
     (B,E,C,D) form, with dense contiguous GEMMs instead of scatters.
 
@@ -343,7 +393,6 @@ def _moe_ffn_grouped_ep(h, router_w, w1, w3, w2, config, mesh):
     E_loc = E // ep
     C = moe_capacity(S, E, K, cfg.moe_capacity_factor)
     N = S * K
-    N_cap = min(N, E_loc * C)
     from jax.sharding import PartitionSpec as P
 
     def _vary(x, names):
@@ -384,48 +433,40 @@ def _moe_ffn_grouped_ep(h, router_w, w1, w3, w2, config, mesh):
         _, eids, gvals, _, _, valid = _route(h_v, rw_v, E, K, C)
 
         # --- picks owned by THIS expert shard; sentinel E_loc sorts
-        # non-local and capacity-dropped picks to the tail ---
+        # non-local and capacity-dropped picks to the tail. The pick pool
+        # is flattened across the local batch before sorting: TPU's
+        # ragged-dot lowering requires a 2-D lhs (exactly one
+        # non-contracting dim — the rank-3 per-row form is CPU-only; see
+        # _moe_ffn_grouped), and the flat sort is still shard-local ---
+        Ml = Bl * N
+        M_cap = min(Ml, Bl * E_loc * C)  # ≤ C valid picks per (row, expert)
         e0 = jax.lax.axis_index(AXIS_EXPERT) * E_loc
         local = valid & (eids >= e0) & (eids < e0 + E_loc)
-        lids = jnp.where(local, eids - e0, E_loc)
-        order = jnp.argsort(lids, axis=1, stable=True)  # (Bl, N)
-        order_c = order[:, :N_cap]  # static capacity bound: ≤ C per expert
-        tok = order_c // K  # pick n came from token n // K
-        x = jnp.take_along_axis(h_v, tok[..., None], axis=1)  # (Bl,N_cap,D)
-        keep = jnp.take_along_axis(local, order_c, axis=1)
-        x = x * keep[..., None].astype(cdt)
+        lids_f = jnp.where(local, eids - e0, E_loc).reshape(Ml)
+        x, order = _flat_pick_sort(
+            h_v.reshape(Bl * S, D), lids_f, local.reshape(Ml),
+            M_cap, N, S, K, cdt,
+        )  # (M_cap, D) in local-expert-sorted order
         sizes = jnp.sum(
-            (lids[:, :, None] == jnp.arange(E_loc, dtype=lids.dtype)).astype(
+            (lids_f[:, None] == jnp.arange(E_loc, dtype=lids_f.dtype)).astype(
                 jnp.int32
             ),
-            axis=1,
-        )  # (Bl, E_loc): per-local-expert valid pick counts, each ≤ C
+            axis=0,
+        )  # (E_loc,): shard-global valid pick counts, each ≤ Bl·C
 
-        rdn = jax.lax.RaggedDotDimensionNumbers(
-            dot_dimension_numbers=(((2,), (1,)), ((), ())),
-            lhs_ragged_dimensions=[1],
-            rhs_group_dimensions=[0],
-        )
-        gate = jax.nn.silu(
-            jax.lax.ragged_dot_general(x, w1g.astype(cdt), sizes, rdn)
-        )
-        up = jax.lax.ragged_dot_general(x, w3g.astype(cdt), sizes, rdn)
-        out = jax.lax.ragged_dot_general(
-            gate * up, w2g.astype(cdt), sizes, rdn
-        )  # (Bl, N_cap, D) in local-expert-sorted order
-        # rows past a row's group total belong to NO group — their content
-        # is unspecified; zero them before the combine gather
-        total = jnp.sum(sizes, axis=1)  # (Bl,)
-        row_ok = jnp.arange(N_cap)[None, :] < total[:, None]
-        out = out * row_ok[..., None].astype(cdt)
+        gate = jax.nn.silu(jax.lax.ragged_dot(x, w1g.astype(cdt), sizes))
+        up = jax.lax.ragged_dot(x, w3g.astype(cdt), sizes)
+        out = jax.lax.ragged_dot(
+            gate * up, w2g.astype(cdt), sizes
+        )  # (M_cap, D) in local-expert-sorted order
+        # rows past the group total belong to NO group — their content is
+        # unspecified; zero them before the combine gather
+        row_ok = jnp.arange(M_cap) < jnp.sum(sizes)
+        out = out * row_ok[:, None].astype(cdt)
 
-        # --- combine: pad to N rows and gather each pick's sorted position
-        # (non-local picks land in the zero padding / zeroed tail) ---
-        out_ext = jnp.pad(out, ((0, 0), (0, N - N_cap), (0, 0)))
-        inv = jnp.argsort(order, axis=1)  # pick -> sorted position
-        y_picks = jnp.take_along_axis(out_ext, inv[..., None], axis=1)
+        # --- combine (non-local picks land in the zero padding / tail) ---
         wgt = jnp.where(local, gvals, 0.0).astype(cdt)
-        y_part = jnp.sum((y_picks * wgt[..., None]).reshape(Bl, S, K, D), axis=2)
+        y_part = _flat_pick_combine(out, order, wgt, Bl, S, K, cdt)
         # ONE all-reduce: sums the disjoint expert-shard contributions AND
         # the row-parallel w2 partials over tensor. f32: sub-f32
         # all-reduces CHECK-fail on the CPU backend (tests/virtual mesh).

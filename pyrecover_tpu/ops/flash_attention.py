@@ -199,11 +199,16 @@ def _fwd(q, k, v, seg, *, causal, scale, block_q, block_kv):
     ]
     inputs = [qt, kt, vt]
     if has_segments:
+        # (b, 1, s): Mosaic requires the last-two block dims to divide
+        # (8, 128) or equal the array dims — a (1, bq) block over (b, s)
+        # fails that on real TPU (the sublane dim 1 vs b); the dummy
+        # middle axis makes the trailing block dims (1, bq) legal.
+        seg3 = seg.reshape(b, 1, seg.shape[1])
         in_specs += [
-            pl.BlockSpec((1, bq), lambda bi, hi, qi, ki: (bi, qi)),
-            pl.BlockSpec((1, bk), lambda bi, hi, qi, ki: (bi, ki)),
+            pl.BlockSpec((1, 1, bq), lambda bi, hi, qi, ki: (bi, 0, qi)),
+            pl.BlockSpec((1, 1, bk), lambda bi, hi, qi, ki: (bi, 0, ki)),
         ]
-        inputs += [seg, seg]
+        inputs += [seg3, seg3]
     out, lse = pl.pallas_call(
         kernel,
         grid=(b, hq, nq, nk),
@@ -399,11 +404,13 @@ def _bwd(causal, scale, block_q, block_kv, res, g):
     ]
     dq_inputs = [qt, kt, vt, dot, outt, lse]
     if has_segments:
+        # (b, 1, s) for Mosaic block-shape legality — see _fwd
+        seg3 = seg.reshape(b, 1, seg.shape[1])
         dq_in_specs += [
-            pl.BlockSpec((1, bq), lambda bi, hi, qi, ki: (bi, qi)),
-            pl.BlockSpec((1, bk), lambda bi, hi, qi, ki: (bi, ki)),
+            pl.BlockSpec((1, 1, bq), lambda bi, hi, qi, ki: (bi, 0, qi)),
+            pl.BlockSpec((1, 1, bk), lambda bi, hi, qi, ki: (bi, 0, ki)),
         ]
-        dq_inputs += [seg, seg]
+        dq_inputs += [seg3, seg3]
     dq = pl.pallas_call(
         dq_kernel,
         grid=(b, hq, nq, nk),
@@ -443,10 +450,10 @@ def _bwd(causal, scale, block_q, block_kv, res, g):
     dkv_inputs = [qt, kt, vt, dot, outt, lse]
     if has_segments:
         dkv_in_specs += [
-            pl.BlockSpec((1, bq), lambda bi, hi, ki, t: (bi, qblock(t))),
-            pl.BlockSpec((1, bk), lambda bi, hi, ki, t: (bi, ki)),
+            pl.BlockSpec((1, 1, bq), lambda bi, hi, ki, t: (bi, 0, qblock(t))),
+            pl.BlockSpec((1, 1, bk), lambda bi, hi, ki, t: (bi, 0, ki)),
         ]
-        dkv_inputs += [seg, seg]
+        dkv_inputs += [seg3, seg3]
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(b, hkv, nk, nq * group),

@@ -17,17 +17,39 @@ OUT=BENCH_r05_raw.jsonl
 LOG=tools/bench_campaign.log
 touch "$OUT"
 
-TAGS=(moe-grouped moe-scatter moe-einsum headline seq8192 packed-ab)
+# headline first (the flagship regression row), grouped last: a row that
+# errors must not starve the queue (each attempt still costs a compile)
+TAGS=(headline moe-scatter moe-einsum seq8192 packed-ab moe-grouped)
 CMDS=(
-  "python bench.py --model moe-4x1b --seq-len 1024 --batch-size 4 --moe-dispatch grouped --skip-ckpt --steps 10"
+  "python bench.py --steps 10"
   "python bench.py --model moe-4x1b --seq-len 1024 --batch-size 4 --moe-dispatch scatter --skip-ckpt --steps 10"
   "python bench.py --model moe-4x1b --seq-len 1024 --batch-size 4 --moe-dispatch einsum --skip-ckpt --steps 10"
-  "python bench.py --steps 10"
   "python bench.py --seq-len 8192 --batch-size 2 --skip-ckpt --steps 5"
   "python tools/bench_packed.py --steps 20"
+  "python bench.py --model moe-4x1b --seq-len 1024 --batch-size 4 --moe-dispatch grouped --skip-ckpt --steps 10"
 )
 
 log() { echo "$(date -u +%FT%TZ) $*" >> "$LOG"; }
+
+# a fresh interpreter must reach the accelerator quickly
+probe() { timeout 120 python -c "import jax; assert jax.devices()[0].platform != 'cpu'" >/dev/null 2>&1; }
+
+# One bound covers every failure mode (compile error, hang, bad JSON, cpu
+# fallback): a row gets at most MAX_ATTEMPTS launches EVER, counted from
+# the "running row" lines already in the log — no failure classification,
+# no per-run reset semantics to get wrong. On exhaustion an honest
+# "skipped" sentinel is recorded so all_done converges. After fixing a
+# row's code, truncate $LOG (or delete its lines) to grant fresh budget.
+MAX_ATTEMPTS=8
+attempts_of() { grep -c "running row $1\$" "$LOG"; }
+exhausted() {
+  if [ "$(attempts_of "$1")" -ge "$MAX_ATTEMPTS" ]; then
+    log "row $1 gave up after $MAX_ATTEMPTS attempts"
+    echo "{\"tag\": \"$1\", \"skipped\": true, \"reason\": \"failed ${MAX_ATTEMPTS}x; see $LOG\"}" >> "$OUT"
+    return 0
+  fi
+  return 1
+}
 
 all_done() {
   for t in "${TAGS[@]}"; do
@@ -38,8 +60,7 @@ all_done() {
 
 log "campaign start"
 while ! all_done; do
-  # probe: a fresh interpreter must reach the accelerator within 120 s
-  if ! timeout 120 python -c "import jax; assert jax.devices()[0].platform != 'cpu'" >/dev/null 2>&1; then
+  if ! probe; then
     log "probe failed; sleeping 300s"
     sleep 300
     continue
@@ -48,10 +69,25 @@ while ! all_done; do
   for i in "${!TAGS[@]}"; do
     t="${TAGS[$i]}"
     grep -q "\"tag\": \"$t\"" "$OUT" && continue
+    exhausted "$t" && continue
     log "running row $t"
+    row_t0=$(date +%s)
     line=$(timeout 2400 ${CMDS[$i]} 2>>"$LOG" | tail -1)
+    row_dur=$(( $(date +%s) - row_t0 ))
     if [ -z "$line" ]; then
-      log "row $t produced no output (hang/timeout); breaking to re-probe"
+      # No output is either a deterministic compile error (skip to the
+      # next row so it can't starve the queue) or the tunnel dying
+      # mid-row. Distinguish by DURATION, not a probe after the fact: a
+      # row that died within minutes failed on its own (the tunnel was
+      # probed alive just before it started), while a long hang that ate
+      # its timeout is tunnel death — by then an after-the-fact probe
+      # often sees the tunnel recovered and would misclassify.
+      if [ "$row_dur" -lt 600 ] && probe; then
+        log "row $t errored quickly with tunnel alive; skipping to next row"
+        sleep 30
+        continue
+      fi
+      log "row $t produced no output in ${row_dur}s (tunnel death); breaking to re-probe"
       break
     fi
     # NOTE: the JSON line rides argv — a heredoc would REPLACE a stdin
