@@ -17,9 +17,14 @@ OUT=BENCH_r05_raw.jsonl
 LOG=tools/bench_campaign.log
 touch "$OUT"
 
-# headline first (the flagship regression row), grouped last: a row that
-# errors must not starve the queue (each attempt still costs a compile)
-TAGS=(headline moe-scatter moe-einsum seq8192 packed-ab moe-grouped)
+# Queue order = value per tunnel-minute: the two rows that validate this
+# round's on-chip kernel fixes first (packed-ab drives the flash segment
+# fix, moe-grouped the ragged-dot fix — both code paths are FIXED since
+# their earlier failed attempts), then the cheap refresh rows, then the
+# long flash-block sweep last so it can't eat a short window another row
+# could have used.
+TAGS=(headline moe-scatter moe-einsum seq8192 packed-ab moe-grouped
+      remat-saveattn moe-8x150m dense-150m flash-blocks)
 CMDS=(
   "python bench.py --steps 10"
   "python bench.py --model moe-4x1b --seq-len 1024 --batch-size 4 --moe-dispatch scatter --skip-ckpt --steps 10"
@@ -27,6 +32,10 @@ CMDS=(
   "python bench.py --seq-len 8192 --batch-size 2 --skip-ckpt --steps 5"
   "python tools/bench_packed.py --steps 20"
   "python bench.py --model moe-4x1b --seq-len 1024 --batch-size 4 --moe-dispatch grouped --skip-ckpt --steps 10"
+  "python bench.py --remat-policy save-attn --skip-ckpt --steps 10"
+  "python bench.py --model moe-8x150m --seq-len 1024 --batch-size 8 --skip-ckpt --steps 10"
+  "python bench.py --model llama-150m --seq-len 1024 --batch-size 8 --skip-ckpt --steps 10"
+  "python tools/bench_flash_blocks.py"
 )
 
 log() { echo "$(date -u +%FT%TZ) $*" >> "$LOG"; }
@@ -102,6 +111,8 @@ except Exception:
     sys.exit(1)
 if d.get("extra", {}).get("platform") == "cpu":
     sys.exit(1)  # tunnel died mid-run; bench fell back — retry this row
+if d.get("value") is None:
+    sys.exit(1)  # bench ran but measured nothing trustworthy — retry
 d["tag"] = tag
 print(json.dumps(d))
 PYEOF
