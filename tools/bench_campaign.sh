@@ -24,7 +24,7 @@ touch "$OUT"
 # long flash-block sweep last so it can't eat a short window another row
 # could have used.
 TAGS=(headline moe-scatter moe-einsum seq8192 packed-ab moe-grouped
-      remat-saveattn moe-8x150m dense-150m flash-blocks)
+      remat-saveattn moe-8x150m dense-150m decode flash-blocks)
 CMDS=(
   "python bench.py --steps 10"
   "python bench.py --model moe-4x1b --seq-len 1024 --batch-size 4 --moe-dispatch scatter --skip-ckpt --steps 10"
@@ -35,6 +35,7 @@ CMDS=(
   "python bench.py --remat-policy save-attn --skip-ckpt --steps 10"
   "python bench.py --model moe-8x150m --seq-len 1024 --batch-size 8 --skip-ckpt --steps 10"
   "python bench.py --model llama-150m --seq-len 1024 --batch-size 8 --skip-ckpt --steps 10"
+  "python tools/bench_decode.py"
   "python tools/bench_flash_blocks.py"
 )
 

@@ -1,0 +1,103 @@
+"""KV-cached decode throughput at the flagship preset.
+
+The reference has no generation path (SURVEY §2: training-only); this
+measures OUR serving-path claim — that a decode step costs O(cache fill),
+not O(max_len), and that batched prompts decode in lockstep through one
+cache (models/decode.py). The headline value is steady-state decode
+throughput with the prefill cost CANCELLED: two timed generations (1 new
+token vs N new tokens) share an identical prefill, so their time
+difference is N-1 pure decode steps.
+
+Prints ONE JSON line:
+  {"metric": "decode_tok_per_sec", "value": N, "unit": "tok/s",
+   "extra": {"per_seq_tok_s": ..., "ms_per_step": ..., "platform": ...}}
+
+Run (tunnel up): python tools/bench_decode.py [--batch 8] [--new 128] ...
+"""
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from bench import _guard_against_dead_accelerator  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="llama-1b")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=128)
+    ap.add_argument("--new", type=int, default=128)
+    ap.add_argument("--max-len", type=int, default=512)
+    args = ap.parse_args()
+
+    _guard_against_dead_accelerator()
+
+    import jax
+    import numpy as np
+
+    from pyrecover_tpu.models import presets
+    from pyrecover_tpu.models.decode import generate_tokens
+    from pyrecover_tpu.models.llama import init_params
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu" and args.model == "llama-1b":
+        # CPU fallback (dead tunnel): shrink like bench.py does so an
+        # honest platform=cpu line still prints inside the campaign's row
+        # timeout instead of grinding a 1B decode on one core. The
+        # recorder retries cpu rows, so this line is evidence, not data.
+        args.model, args.batch, args.new = "llama-150m", 2, 16
+        args.prompt_len, args.max_len = 16, 64
+
+    cfg = dataclasses.replace(
+        presets.PRESETS[args.model](max_seq_len=args.max_len),
+        param_dtype="bfloat16", compute_dtype="bfloat16", remat=False,
+    )
+    params = init_params(jax.random.key(0), cfg)
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(
+        0, cfg.vocab_size, (args.batch, args.prompt_len)
+    ).tolist()
+
+    # warmup: compiles the prefill (chunk=prompt_len) and the chunk=1 step
+    generate_tokens(params, cfg, prompts, 4, max_len=args.max_len)
+
+    # two timed runs with IDENTICAL prefill: their difference is N-1 pure
+    # decode steps, so the prefill cost cancels out of the headline
+    t0 = time.perf_counter()
+    generate_tokens(params, cfg, prompts, 1, max_len=args.max_len)
+    t_one = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = generate_tokens(params, cfg, prompts, args.new,
+                          max_len=args.max_len)
+    t_full = time.perf_counter() - t0
+    assert len(out) == args.batch and all(
+        len(seq) == args.prompt_len + args.new for seq in out
+    )
+    decode_s = max(t_full - t_one, 1e-9)
+    steps = args.new - 1
+    print(json.dumps({
+        "metric": "decode_tok_per_sec",
+        "value": round(args.batch * steps / decode_s, 1),
+        "unit": "tok/s",
+        "extra": {
+            "model": args.model,
+            "batch": args.batch,
+            "prompt_len": args.prompt_len,
+            "new_tokens": args.new,
+            "cache_len": args.max_len,
+            "per_seq_tok_s": round(steps / decode_s, 1),
+            "ms_per_decode_step": round(decode_s / steps * 1e3, 2),
+            "e2e_s_incl_prefill": round(t_full, 3),
+            "platform": platform,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()
