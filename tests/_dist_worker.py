@@ -13,7 +13,7 @@ import jax
 import os
 
 
-def _run_train(workdir, **overrides):
+def _run_train(workdir, model_overrides=None, **overrides):
     """One `train()` call with the tiny 2-proc config (the REAL driver —
     resume, preemption, checkpoint strategy dispatch all included)."""
     from pyrecover_tpu.config import TrainConfig
@@ -31,7 +31,7 @@ def _run_train(workdir, **overrides):
     cfg = TrainConfig(**base)
     cfg.model = ModelConfig(
         dim=64, n_layers=2, n_heads=4, n_kv_heads=2, vocab_size=128,
-        multiple_of=32, max_seq_len=32,
+        multiple_of=32, max_seq_len=32, **(model_overrides or {}),
     )
     cfg.__post_init__()
     return train(cfg)
@@ -123,6 +123,46 @@ def mode_resume(proc_id, workdir, sharded):
     }
 
 
+def mode_moe_ep(proc_id, workdir):
+    """Grouped ragged-GEMM MoE dispatch (the explicitly-SPMD shard_map
+    path, psum over (expert, tensor)) training through the REAL
+    multi-process driver: EP×TP shard within each simulated host (the
+    ICI-friendly layout create_mesh picks) with cross-process data
+    parallelism composed on top, plus Orbax multihost sharded
+    checkpointing of the expert-sharded params. Both hosts must finish
+    every step and agree exactly on the trained parameters."""
+    from pyrecover_tpu.parallel.mesh import MeshConfig
+
+    state, end_step, stopped = _run_train(
+        workdir,
+        model_overrides=dict(
+            n_experts=4, moe_top_k=2, moe_dispatch="grouped"
+        ),
+        mesh=MeshConfig(data=2, tensor=2, expert=2),
+        sharded_checkpoint=True,  # Orbax multihost writes of EP-sharded leaves
+    )
+    # one number per HOST, computed from purely local data: params are
+    # sharded over (expert, tensor) — both axes inside one host on this
+    # mesh — and replicated across the cross-host data axis, so each
+    # host's addressable shards are exactly one full copy. A collective
+    # sum here would be replicated by construction and the cross-host
+    # equality assertion vacuous; summing local shards makes divergent
+    # replicas actually comparable.
+    import numpy as np
+
+    fp = 0.0
+    for leaf in jax.tree_util.tree_leaves(state.params):
+        for shard in leaf.addressable_shards:
+            fp += float(
+                np.sum(np.asarray(shard.data, dtype=np.float32) ** 2)
+            )
+    return {
+        "end_step": end_step,
+        "stopped": stopped,
+        "param_l2sq": round(fp, 6),
+    }
+
+
 def main():
     proc_id = int(sys.argv[1])
     num_procs = int(sys.argv[2])
@@ -144,6 +184,8 @@ def main():
             result = mode_resume(proc_id, workdir, sharded=False)
         elif mode == "resume_sharded":
             result = mode_resume(proc_id, workdir, sharded=True)
+        elif mode == "moe_ep":
+            result = mode_moe_ep(proc_id, workdir)
         else:
             raise SystemExit(f"unknown mode {mode}")
         result["proc"] = proc_id

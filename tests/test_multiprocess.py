@@ -110,3 +110,18 @@ def test_two_process_corrupt_newest_fallback(tmp_path, mode):
     # host 1 emits nothing (log_host0) — its agreement is proven by a
     # clean, non-hanging exit at the same step
     assert not results[1]["fallback_logged"]
+
+
+def test_two_process_grouped_moe_expert_parallel(tmp_path):
+    """The MXU MoE path (grouped ragged-GEMM dispatch inside its
+    explicitly-SPMD shard_map, one psum over (expert, tensor)) training
+    through the real driver on a REAL 2-process mesh: EP×TP within each
+    simulated host, data parallelism across them, expert-sharded params
+    checkpointed multihost. Both hosts must agree bit-for-bit on the
+    trained parameters — the vma/psum AD hazards this path documents
+    (models/moe.py) would show up here as cross-host divergence."""
+    results = run_workers(tmp_path, mode="moe_ep")
+    for proc, r in results.items():
+        assert r["end_step"] == 8, f"proc {proc} ended at {r['end_step']}"
+        assert not r["stopped"]
+    assert results[0]["param_l2sq"] == results[1]["param_l2sq"]
