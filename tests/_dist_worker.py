@@ -150,16 +150,19 @@ def mode_moe_ep(proc_id, workdir):
     # replicas actually comparable.
     import numpy as np
 
-    fp = 0.0
+    fp = []
     for leaf in jax.tree_util.tree_leaves(state.params):
-        for shard in leaf.addressable_shards:
-            fp += float(
-                np.sum(np.asarray(shard.data, dtype=np.float32) ** 2)
-            )
+        fp.append(sum(
+            float(np.sum(np.asarray(shard.data, dtype=np.float32) ** 2))
+            for shard in leaf.addressable_shards
+        ))
+    # per-leaf, full float precision (json round-trips doubles exactly):
+    # a single rounded total would hide sub-1e-6 divergence and
+    # compensating per-leaf differences
     return {
         "end_step": end_step,
         "stopped": stopped,
-        "param_l2sq": round(fp, 6),
+        "param_l2sq": fp,
     }
 
 

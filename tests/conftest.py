@@ -99,3 +99,18 @@ def run_train_steps(mesh_cfg, model_cfg, train_cfg, n_steps=3, data_seed=3):
             state, m = step_fn(state, batch)
             losses.append(float(m["loss"]))
     return state, losses
+
+
+def assert_params_match(ref_state, state, rtol=2e-3, atol=2e-3):
+    """Per-leaf closeness of two TrainState param trees (the standard
+    sharded-vs-single-device equality check; strict zip catches a
+    leaf-count drift between the trees)."""
+    import numpy as np
+
+    ref_leaves = jax.tree_util.tree_leaves(ref_state.params)
+    leaves = jax.tree_util.tree_leaves(state.params)
+    for a, b in zip(ref_leaves, leaves, strict=True):
+        np.testing.assert_allclose(
+            np.asarray(a, dtype=np.float32), np.asarray(b, dtype=np.float32),
+            rtol=rtol, atol=atol,
+        )

@@ -9,7 +9,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from conftest import run_train_steps
+from conftest import assert_params_match, run_train_steps
 from jax.sharding import PartitionSpec as P
 
 from pyrecover_tpu.config import TrainConfig
@@ -49,14 +49,7 @@ def test_expert_parallel_matches_single_device(single_device_run, mesh_cfg, devi
     ref_state, ref_losses = single_device_run
     state, losses = run_steps(mesh_cfg)
     np.testing.assert_allclose(losses, ref_losses, rtol=5e-4, atol=5e-4)
-    for a, b in zip(
-        jax.tree_util.tree_leaves(ref_state.params),
-        jax.tree_util.tree_leaves(state.params),
-    ):
-        np.testing.assert_allclose(
-            np.asarray(a, dtype=np.float32), np.asarray(b, dtype=np.float32),
-            rtol=2e-3, atol=2e-3,
-        )
+    assert_params_match(ref_state, state)
 
 
 @pytest.mark.slow
@@ -211,14 +204,7 @@ def test_grouped_dispatch_dp_fsdp_matches_single_device(single_device_run,
     cfg = dataclasses.replace(MOE_CFG, moe_dispatch="grouped")
     state, losses = run_steps(MeshConfig(data=4, fsdp=2), cfg)
     np.testing.assert_allclose(losses, ref_losses, rtol=5e-4, atol=5e-4)
-    for a, b in zip(
-        jax.tree_util.tree_leaves(ref_state.params),
-        jax.tree_util.tree_leaves(state.params),
-    ):
-        np.testing.assert_allclose(
-            np.asarray(a, dtype=np.float32), np.asarray(b, dtype=np.float32),
-            rtol=2e-3, atol=2e-3,
-        )
+    assert_params_match(ref_state, state)
 
 
 @pytest.mark.parametrize(
@@ -242,14 +228,7 @@ def test_grouped_dispatch_expert_parallel_matches_single_device(
     cfg = dataclasses.replace(MOE_CFG, moe_dispatch="grouped")
     state, losses = run_steps(mesh_cfg, cfg)
     np.testing.assert_allclose(losses, ref_losses, rtol=5e-4, atol=5e-4)
-    for a, b in zip(
-        jax.tree_util.tree_leaves(ref_state.params),
-        jax.tree_util.tree_leaves(state.params),
-    ):
-        np.testing.assert_allclose(
-            np.asarray(a, dtype=np.float32), np.asarray(b, dtype=np.float32),
-            rtol=2e-3, atol=2e-3,
-        )
+    assert_params_match(ref_state, state)
 
 
 def test_grouped_ep_gradients_match_scatter(devices8):
@@ -317,3 +296,70 @@ def test_analytic_param_count_matches_init():
 
     params = init_params(jax.random.key(0), MOE_CFG)
     assert analytic_param_count(MOE_CFG) == get_num_params(params)
+
+
+@pytest.mark.slow
+def test_grouped_dispatch_seq_parallel_matches_single_device(
+    single_device_run, devices8
+):
+    """Explicit moe_dispatch='grouped' under a SHARDED SEQUENCE axis: the
+    shard-local manual form is inexpressible there (it would un-shard the
+    activations), so the batch-global flat-sort form runs and GSPMD pays
+    the gathers — correctness must survive that resharding."""
+    ref_state, ref_losses = single_device_run
+    cfg = dataclasses.replace(MOE_CFG, moe_dispatch="grouped")
+    state, losses = run_steps(MeshConfig(data=2, sequence=2, tensor=2), cfg)
+    np.testing.assert_allclose(losses, ref_losses, rtol=5e-4, atol=5e-4)
+    assert_params_match(ref_state, state)
+
+
+def test_auto_dispatch_policy_matrix(devices8, monkeypatch):
+    """Pin WHICH backend the auto pick routes to per mesh shape — the
+    policy encodes real hardware constraints (batch-global sort gathers a
+    sharded batch; the manual form can't express sp > 1; TPU-illegal
+    rank-3 ragged dots started this) and a silent policy regression would
+    surface only as multichip slowdown, which no equality test catches."""
+    import pyrecover_tpu.models.moe as moe_mod
+
+    calls = []
+    for name in ("_moe_ffn_grouped", "_moe_ffn_grouped_ep", "_moe_ffn_impl",
+                 "_moe_ffn_einsum"):
+        real = getattr(moe_mod, name)
+
+        def wrapper(*a, _real=real, _name=name, **kw):
+            calls.append(_name)
+            return _real(*a, **kw)
+
+        monkeypatch.setattr(moe_mod, name, wrapper)
+
+    cfg = MOE_CFG
+    B, S, D = 8, 32, cfg.dim
+    key = jax.random.PRNGKey(0)
+    h = jax.random.normal(key, (B, S, D), jnp.float32)
+    params = init_params(jax.random.PRNGKey(1), cfg)
+    l0 = jax.tree_util.tree_map(lambda x: x[0], params["layers"])
+    rw, w1, w3, w2 = (l0["router"], l0["moe_w1"], l0["moe_w3"], l0["moe_w2"])
+
+    def pick_for(mesh_cfg):
+        calls.clear()
+        if mesh_cfg is None:
+            jax.eval_shape(lambda *a: moe_mod.moe_ffn(*a, cfg),
+                           h, rw, w1, w3, w2)
+        else:
+            mesh = create_mesh(mesh_cfg, devices=jax.devices()[:8])
+            with jax.sharding.set_mesh(mesh):
+                jax.eval_shape(lambda *a: moe_mod.moe_ffn(*a, cfg),
+                               h, rw, w1, w3, w2)
+        assert calls, "no dispatch backend was invoked"
+        return calls[0]
+
+    # unsharded: the flat MXU path
+    assert pick_for(None) == "_moe_ffn_grouped"
+    # batch sharded, ep == 1: the shard-local manual form
+    assert pick_for(MeshConfig(data=4, fsdp=2)) == "_moe_ffn_grouped_ep"
+    # sequence sharded: both grouped forms would gather; scatter/einsum
+    assert pick_for(MeshConfig(data=4, sequence=2)) in (
+        "_moe_ffn_impl", "_moe_ffn_einsum")
+    # expert sharded: auto stays conservative until grouped-EP is measured
+    assert pick_for(MeshConfig(data=4, expert=2)) in (
+        "_moe_ffn_impl", "_moe_ffn_einsum")
