@@ -70,6 +70,9 @@ from pyrecover_tpu.parallel.mesh import (
 )
 
 
+_warned_grouped_sp = False  # once-per-process guard for the sp>1 warning
+
+
 def moe_capacity(seq_len, n_experts, top_k, capacity_factor):
     """Per-row expert capacity: ceil(S·k·cf / E), min 1. Static."""
     return max(1, int(math.ceil(seq_len * top_k * capacity_factor / n_experts)))
@@ -182,7 +185,24 @@ def moe_ffn(h, router_w, w1, w3, w2, config):
             return _moe_ffn_grouped_ep(h, router_w, w1, w3, w2, config, mesh)
         # fully-local mesh — or sp > 1 with ep == 1, where the manual form
         # is inexpressible and the batch-global sort's gathers are the
-        # price of an explicit 'grouped' request under sequence sharding
+        # price of an explicit 'grouped' request under sequence sharding.
+        # Loud (the repo's fallback convention, cf. ring attention), but
+        # once per process — moe_ffn traces once per layer per retrace,
+        # and 32 identical lines bury the signal.
+        global _warned_grouped_sp
+        if sp > 1 and not _warned_grouped_sp:
+            _warned_grouped_sp = True
+            import logging
+
+            from pyrecover_tpu.utils.logging import log_host0
+
+            log_host0(
+                "moe_dispatch='grouped' with a sharded sequence axis "
+                "(sp=%d): the batch-global sort re-gathers the "
+                "seq-sharded activations every MoE layer; "
+                "'scatter'/'einsum' keep sp intact",
+                sp, level=logging.WARNING,
+            )
         return _moe_ffn_grouped(h, router_w, w1, w3, w2, config)
     if choice == "auto":
         # Measured on v5e (8x150m, S=1024, fwd+bwd per MoE layer): einsum
