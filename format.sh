@@ -1,6 +1,37 @@
 #!/usr/bin/env bash
 # Lint/format harness (parity with reference format.sh).
-set -e
-python -m isort pyrecover_tpu tests tools bench.py __graft_entry__.py 2>/dev/null || true
-python -m black pyrecover_tpu tests tools bench.py __graft_entry__.py 2>/dev/null || true
-python -m flake8 --max-line-length 100 pyrecover_tpu 2>/dev/null || true
+#
+# Usage:
+#   ./format.sh           rewrite files in place
+#   ./format.sh --check   report-only mode (CI): exit 1 on violations,
+#                         rewrite nothing
+#
+# Formatters that are not installed are skipped with a note (the container
+# may not ship them); a missing tool is never a failure.
+set -u
+
+TARGETS="pyrecover_tpu tests tools bench.py __graft_entry__.py"
+ISORT_ARGS=""
+BLACK_ARGS=""
+if [ "${1:-}" = "--check" ]; then
+  ISORT_ARGS="--check-only --diff"
+  BLACK_ARGS="--check --diff"
+fi
+
+rc=0
+if python -c "import isort" 2>/dev/null; then
+  python -m isort $ISORT_ARGS $TARGETS || rc=1
+else
+  echo "isort not installed; skipped"
+fi
+if python -c "import black" 2>/dev/null; then
+  python -m black $BLACK_ARGS $TARGETS || rc=1
+else
+  echo "black not installed; skipped"
+fi
+if python -c "import flake8" 2>/dev/null; then
+  python -m flake8 --max-line-length 100 pyrecover_tpu || rc=1
+else
+  echo "flake8 not installed; skipped"
+fi
+exit $rc

@@ -69,3 +69,9 @@ def _honor_jax_platforms_env():
 
 
 _honor_jax_platforms_env()
+
+# Fill older-jax API gaps (sharding context, shard_map spelling) before any
+# module references them; a complete no-op on current jax.
+from pyrecover_tpu.utils.compat import install_jax_compat as _install_jax_compat
+
+_install_jax_compat()

@@ -87,4 +87,13 @@ def prune_checkpoints(exp_dir, max_keep, *, sharded=None):
             for sidecar in (p.with_suffix(p.suffix + ".sha256"),
                             p.with_suffix(p.suffix + ".md5")):
                 sidecar.unlink(missing_ok=True)
+    if doomed:
+        from pyrecover_tpu import telemetry
+
+        telemetry.emit(
+            "ckpt_prune",
+            engine="sharded" if sharded else "vanilla" if sharded is False
+            else "any",
+            count=len(doomed), removed=[p.name for p in doomed],
+        )
     return doomed

@@ -22,6 +22,7 @@ from pathlib import Path
 import jax
 import orbax.checkpoint as ocp
 
+from pyrecover_tpu import telemetry
 from pyrecover_tpu.checkpoint.registry import prune_checkpoints
 from pyrecover_tpu.checkpoint.vanilla import CheckpointStructureError
 from pyrecover_tpu.utils.logging import log_host0
@@ -45,6 +46,10 @@ class ShardedCheckpointer:
         seconds spent blocking the training loop."""
         t0 = time.monotonic()
         path = Path(path).absolute()
+        telemetry.emit(
+            "ckpt_save_start", engine="sharded", path=str(path),
+            async_=self.use_async,
+        )
         meta = {"sampler": sampler_state or {}}
         if extra_meta:
             meta.update(extra_meta)
@@ -61,16 +66,31 @@ class ShardedCheckpointer:
             # tmp dir is invisible to the registry until orbax renames it.
             if jax.process_index() == 0:
                 prune_checkpoints(path.parent, max_keep, sharded=True)
-        return time.monotonic() - t0
+        blocking_s = time.monotonic() - t0
+        telemetry.emit(
+            "ckpt_save_blocking", engine="sharded", path=str(path),
+            blocking_s=round(blocking_s, 4), async_=self.use_async,
+        )
+        return blocking_s
 
     def wait(self):
         """Block until any in-flight async save is durable."""
         if hasattr(self._ckptr, "wait_until_finished"):
+            t0 = time.monotonic()
             self._ckptr.wait_until_finished()
+            # background seconds the training loop did NOT pay for: the gap
+            # between dispatch (blocking_s) and durability shows up here
+            # only when someone waits — final saves and shutdown
+            telemetry.emit(
+                "ckpt_save_durable", engine="sharded",
+                wait_s=round(time.monotonic() - t0, 4),
+            )
 
     def restore(self, path, target_state):
         """Restore onto the shardings carried by ``target_state``'s leaves."""
         path = Path(path).absolute()
+        t0 = time.monotonic()
+        telemetry.emit("ckpt_restore_start", engine="sharded", path=str(path))
         restore_args = ocp.checkpoint_utils.construct_restore_args(target_state)
         result = self._ckptr.restore(
             path,
@@ -82,6 +102,11 @@ class ShardedCheckpointer:
             ),
         )
         meta = result.meta or {}
+        telemetry.emit(
+            "ckpt_restore_done", engine="sharded", path=str(path),
+            seconds=round(time.monotonic() - t0, 4),
+            step=int(meta.get("step", 0)),
+        )
         return result.state, meta.get("sampler", {}), meta
 
     def close(self):

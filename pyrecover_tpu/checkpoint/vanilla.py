@@ -40,6 +40,7 @@ import jax
 import numpy as np
 from flax.serialization import msgpack_restore
 
+from pyrecover_tpu import telemetry
 from pyrecover_tpu.checkpoint.registry import prune_checkpoints
 from pyrecover_tpu.parallel.mesh import sync_global_devices
 from pyrecover_tpu.utils.logging import log_host0
@@ -211,6 +212,10 @@ def save_ckpt_vanilla(path, state, sampler_state=None, *, verify=False,
     """
     t0 = time.monotonic()
     path = Path(path)
+    telemetry.emit(
+        "ckpt_save_start", engine="vanilla", path=str(path),
+        background=bool(background),
+    )
     sync_global_devices("vanilla_save_enter")
 
     path_leaves, treedef = jax.tree_util.tree_flatten_with_path(state)
@@ -260,7 +265,12 @@ def save_ckpt_vanilla(path, state, sampler_state=None, *, verify=False,
             t.start()
         # no exit barrier in background mode: the remaining work is
         # host-0-local, so other hosts have nothing to wait for
-        return time.monotonic() - t0, handle
+        blocking_s = time.monotonic() - t0
+        telemetry.emit(
+            "ckpt_save_blocking", engine="vanilla", path=str(path),
+            blocking_s=round(blocking_s, 4), background=True,
+        )
+        return blocking_s, handle
 
     # synchronous: interleave gather → write → free, one leaf live at a
     # time. Every host walks the SAME leaf order so the allgather
@@ -276,7 +286,12 @@ def save_ckpt_vanilla(path, state, sampler_state=None, *, verify=False,
             del arr
 
     sync_global_devices("vanilla_save_exit")
-    return time.monotonic() - t0
+    blocking_s = time.monotonic() - t0
+    telemetry.emit(
+        "ckpt_save_blocking", engine="vanilla", path=str(path),
+        blocking_s=round(blocking_s, 4), background=False,
+    )
+    return blocking_s
 
 
 def _write_stream(path, leaves_iter, meta, verify, max_keep):
@@ -288,6 +303,8 @@ def _write_stream(path, leaves_iter, meta, verify, max_keep):
     after reinterpreting the buffer as uint8), so peak extra RAM is the
     checksum's chunk buffer — plus a one-leaf copy only if a leaf arrives
     non-contiguous."""
+    t0 = time.monotonic()
+    written = 0
     path.parent.mkdir(parents=True, exist_ok=True)
     meta_b = json.dumps(meta).encode()
     checksum = _IncrementalChecksum() if verify else None
@@ -296,7 +313,9 @@ def _write_stream(path, leaves_iter, meta, verify, max_keep):
         with os.fdopen(fd, "wb", buffering=4 * 1024 * 1024) as f:
 
             def w(b):
+                nonlocal written
                 f.write(b)
+                written += len(b)
                 if checksum is not None:
                     checksum.update(b)
 
@@ -318,6 +337,10 @@ def _write_stream(path, leaves_iter, meta, verify, max_keep):
             os.unlink(tmp)
     if verify:
         _sidecar(path).write_text(checksum.result())
+    telemetry.emit(
+        "ckpt_commit", engine="vanilla", path=str(path), bytes=written,
+        write_s=round(time.monotonic() - t0, 4), checksum=bool(verify),
+    )
     if max_keep:
         prune_checkpoints(path.parent, max_keep, sharded=False)
 
@@ -499,6 +522,8 @@ def load_ckpt_vanilla(path, target_state, *, verify=False):
     checkpoint.py:151-178). Returns (state, sampler_state, meta).
     """
     path = Path(path)
+    t0 = time.monotonic()
+    telemetry.emit("ckpt_restore_start", engine="vanilla", path=str(path))
     sync_global_devices("vanilla_load_enter")
     if jax.process_count() > 1 and jax.process_index() > 0:
         stagger = float(os.environ.get("PYRECOVER_LOAD_STAGGER_S", "3"))
@@ -553,4 +578,9 @@ def load_ckpt_vanilla(path, target_state, *, verify=False):
         log_host0("Checkpoint checksum verified: %s", path)
 
     sync_global_devices("vanilla_load_exit")
+    telemetry.emit(
+        "ckpt_restore_done", engine="vanilla", path=str(path),
+        seconds=round(time.monotonic() - t0, 4), verified=bool(verify),
+        step=int(meta.get("step", 0)),
+    )
     return state, meta.get("sampler", {}), meta

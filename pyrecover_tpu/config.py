@@ -96,6 +96,12 @@ class TrainConfig:
     # -- observability -------------------------------------------------------
     logging_frequency: int = 5
     log_loss_to_csv: bool = False
+    # structured telemetry (pyrecover_tpu/telemetry): host-0 JSONL event
+    # stream with step timing, checkpoint lifecycle, preemption, and
+    # run-summary goodput events; tools/summarize_telemetry.py reads it
+    telemetry: bool = False
+    telemetry_path: str = ""  # "" → <ckpt_dir>/<exp>/<exp>_telemetry.jsonl
+    telemetry_stdout: bool = False  # mirror events into the host-0 text log
     profile: bool = False
     profile_step_start: int = 10
     profile_step_end: int = 12
@@ -277,6 +283,15 @@ def build_parser():
     # observability (utils.py:152-170, 249-254)
     p.add_argument("--logging-frequency", type=int, default=d.logging_frequency)
     p.add_argument("--log-loss-to-csv", action="store_true")
+    p.add_argument("--telemetry", action="store_true",
+                   help="Emit a structured JSONL event stream (step timing, "
+                        "checkpoint lifecycle, preemption, goodput summary); "
+                        "read it with tools/summarize_telemetry.py.")
+    p.add_argument("--telemetry-path", type=str, default=d.telemetry_path,
+                   help="Telemetry JSONL path; default "
+                        "<checkpoint-dir>/<experiment>/<experiment>_telemetry.jsonl.")
+    p.add_argument("--telemetry-stdout", action="store_true",
+                   help="Also mirror telemetry events into the host-0 log.")
     p.add_argument("--profile", action="store_true")
     p.add_argument("--profile-step-start", type=int, default=d.profile_step_start)
     p.add_argument("--profile-step-end", type=int, default=d.profile_step_end)
@@ -347,6 +362,9 @@ def get_args(argv=None):
         eval_dataset=ns.eval_dataset,
         logging_frequency=ns.logging_frequency,
         log_loss_to_csv=ns.log_loss_to_csv,
+        telemetry=ns.telemetry,
+        telemetry_path=ns.telemetry_path,
+        telemetry_stdout=ns.telemetry_stdout,
         profile=ns.profile,
         profile_step_start=ns.profile_step_start,
         profile_step_end=ns.profile_step_end,

@@ -17,12 +17,18 @@ stack (train.py:69-84). Differences, all TPU-motivated:
 
 import queue
 import threading
+import time
 
 import jax
 from jax.sharding import NamedSharding
 
+from pyrecover_tpu import telemetry
 from pyrecover_tpu.data.collate import collate_clm
 from pyrecover_tpu.parallel.sharding import batch_pspec
+
+# a consumer wait above this is a real stall (the prefetch queue ran dry),
+# not scheduler noise — emitted as a `data_stall` telemetry event
+_STALL_EVENT_THRESHOLD_S = 1e-3
 
 
 class DataLoader:
@@ -37,6 +43,9 @@ class DataLoader:
         self._queue = None
         self._thread = None
         self._stop = threading.Event()
+        self.batches_served = 0
+        self.stall_count = 0
+        self.stall_s = 0.0
         self._sharding = (
             NamedSharding(mesh, batch_pspec()) if mesh is not None else None
         )
@@ -118,7 +127,23 @@ class DataLoader:
         if self.prefetch > 0:
             if self._thread is None:
                 self.start()
-            item = self._queue.get()
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                # the prefetch queue ran dry: the consumer (the train loop)
+                # is now stalled on host-side tokenize/collate — the exact
+                # signal that says "add workers / deepen prefetch"
+                t0 = time.monotonic()
+                item = self._queue.get()
+                waited = time.monotonic() - t0
+                self.stall_count += 1
+                self.stall_s += waited
+                if waited >= _STALL_EVENT_THRESHOLD_S:
+                    telemetry.emit(
+                        "data_stall", wait_s=round(waited, 6),
+                        depth=self._queue.qsize(),
+                        batch=self.batches_served + 1,
+                    )
             if isinstance(item, Exception):
                 raise item
             epoch, batch = item
@@ -126,6 +151,7 @@ class DataLoader:
             idx = self.sampler.next_batch()
             epoch = self.sampler.epoch
             batch = self._make_batch(idx)
+        self.batches_served += 1
         return epoch, self._to_device(batch)
 
     def __iter__(self):

@@ -36,6 +36,7 @@ import urllib.error
 import urllib.request
 from pathlib import Path
 
+from pyrecover_tpu import telemetry
 from pyrecover_tpu.utils.logging import log_host0
 
 # Default per GCE contract; tests override via $PYRECOVER_METADATA_BASE.
@@ -129,6 +130,7 @@ class MaintenanceEventWatcher:
             "Maintenance/preemption notice from metadata server: %s — "
             "triggering final checkpoint", description,
         )
+        telemetry.emit("maintenance_event", description=description)
         if self.notice_file is not None:
             try:
                 self.notice_file.parent.mkdir(parents=True, exist_ok=True)
@@ -175,6 +177,9 @@ class MaintenanceEventWatcher:
                             "notice-file preemption signals remain active)",
                             errors,
                         )
+                        telemetry.emit(
+                            "maintenance_watcher_retired", errors=errors
+                        )
                         return
                 elif errors == self.max_consecutive_errors:
                     # WAS healthy, now erroring: a network blip mid-job must
@@ -186,6 +191,7 @@ class MaintenanceEventWatcher:
                         "(maintenance-event detection degraded until it "
                         "recovers)", errors, level=30,  # WARNING
                     )
+                    telemetry.emit("maintenance_degraded", errors=errors)
                 # backoff ceiling stays poll_timeout_s (docstring contract):
                 # the blind window must remain inside GCE's ~30 s spot grace
                 self._stop.wait(min(2.0 ** min(errors, 6), self.poll_timeout_s))

@@ -37,6 +37,7 @@ from pathlib import Path
 
 import jax
 
+from pyrecover_tpu import telemetry
 from pyrecover_tpu.parallel.mesh import broadcast_host0_scalar
 from pyrecover_tpu.utils.logging import log_host0
 
@@ -94,10 +95,23 @@ class PreemptionWatcher:
     def observe_iter(self, seconds):
         if seconds > self.max_iter_time:
             self.max_iter_time = seconds
+            if self.enabled:
+                # only on increases, so the event stream stays bounded
+                telemetry.emit(
+                    "preempt_estimate", kind="iter",
+                    seconds=round(seconds, 4),
+                    safety_buffer_s=round(self.safety_buffer, 4),
+                )
 
     def observe_ckpt(self, seconds):
         if seconds > self.max_ckpt_time:
             self.max_ckpt_time = seconds
+            if self.enabled:
+                telemetry.emit(
+                    "preempt_estimate", kind="ckpt",
+                    seconds=round(seconds, 4),
+                    safety_buffer_s=round(self.safety_buffer, 4),
+                )
 
     @property
     def safety_buffer(self):
@@ -189,6 +203,10 @@ class PreemptionWatcher:
                         "coordinating the stop at the next check step "
                         "(<= %d steps away)", self.check_interval - 1,
                     )
+                    telemetry.emit(
+                        "preempt_notice", step=step, coordinated=False,
+                        max_delay_steps=self.check_interval - 1,
+                    )
                 return False
             # single-process: no collective to coordinate — stop now
         decision = False
@@ -196,6 +214,7 @@ class PreemptionWatcher:
         if self._notice_present():
             decision = True
             reason = "preemption notice received"
+            telemetry.emit("preempt_notice", step=step, coordinated=True)
         elif self.job_end_time is not None:
             time_left = self.job_end_time - time.time()
             # up to (check_interval-1) more steps run before the next check
@@ -203,6 +222,13 @@ class PreemptionWatcher:
                 self.check_interval * self.max_iter_time
                 + self.max_ckpt_time
                 + self.safety_buffer
+            )
+            telemetry.emit(
+                "preempt_check", step=step,
+                time_left_s=round(time_left, 2),
+                threshold_s=round(threshold, 2),
+                iter_estimate_s=round(self.max_iter_time, 4),
+                ckpt_estimate_s=round(self.max_ckpt_time, 4),
             )
             if time_left < threshold:
                 decision = True
@@ -213,13 +239,24 @@ class PreemptionWatcher:
         decision = bool(broadcast_host0_scalar(decision))
         if decision and reason:
             log_host0("Stopping for final checkpoint: %s", reason)
+            # the final-save trigger: the run stops here to take its last
+            # checkpoint inside the grace window
+            telemetry.emit("preempt_stop", step=step, reason=reason)
         return decision
 
 
-def write_requeue_marker(exp_dir, *, done=False):
+def write_requeue_marker(exp_dir, *, done=False, step=None):
     """Publish the restart decision for the launcher: REQUEUE means the run
     stopped early (deadline/preemption) and should be resubmitted with
-    --resume-from-checkpoint=latest; DONE means training finished."""
+    --resume-from-checkpoint=latest; DONE means training finished.
+
+    ``step`` (the last completed global step) rides along as the previous
+    attempt's progress high-water mark: the resumed run reads it back
+    (``read_requeue_marker``) to count replayed steps in the goodput
+    accounting. The launcher contract is unchanged — it only tests marker
+    existence."""
+    import json
+
     import jax
 
     if jax.process_index() != 0:
@@ -229,4 +266,36 @@ def write_requeue_marker(exp_dir, *, done=False):
     marker = exp_dir / (DONE_MARKER if done else REQUEUE_MARKER)
     other = exp_dir / (REQUEUE_MARKER if done else DONE_MARKER)
     other.unlink(missing_ok=True)
-    marker.write_text(str(time.time()))
+    payload = {"ts": time.time(), "done": bool(done)}
+    if step is not None:
+        payload["step"] = int(step)
+    marker.write_text(json.dumps(payload))
+
+
+def read_requeue_marker(exp_dir):
+    """Parse whichever marker (REQUEUE or DONE) exists. Returns a dict
+    (``{"ts", "done", "step"?}``) or None. Tolerates the legacy bare-float
+    format and torn/garbage content — markers are advisory."""
+    import json
+
+    exp_dir = Path(exp_dir)
+    for name, done in ((REQUEUE_MARKER, False), (DONE_MARKER, True)):
+        p = exp_dir / name
+        if not p.exists():
+            continue
+        try:
+            text = p.read_text().strip()
+        except OSError:
+            return None
+        try:
+            payload = json.loads(text)
+            if isinstance(payload, dict):
+                payload.setdefault("done", done)
+                return payload
+        except ValueError:
+            pass
+        try:
+            return {"ts": float(text), "done": done}  # legacy format
+        except ValueError:
+            return {"ts": None, "done": done}
+    return None

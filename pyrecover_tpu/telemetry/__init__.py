@@ -1,0 +1,65 @@
+"""pyrecover_tpu.telemetry — structured event bus with pluggable sinks.
+
+The machine-readable observability substrate: every subsystem emits
+structured events (``emit("ckpt_commit", path=..., write_s=...)``) through
+one process-wide bus into pluggable sinks — a host-0 JSONL file for real
+runs, an in-memory list for tests, the text log for eyeballs. Costs
+nothing when no sink is registered and never forces a device sync.
+
+Event envelope (every record):
+    ts      unix seconds (float)
+    event   event name (str)
+    host    jax process index of the emitting host
+
+Core event names across the stack (fields beyond the envelope):
+    run_start         devices, device_kind, processes, mesh, params_m, ...
+    step_time         step, data_wait_s, dispatch_s
+    train_sync        step, loss, steps, interval_s, iter_s, sync_s
+    throughput        step, tokens_per_sec, mfu_pct, tflops, ...
+    eval              step, loss, seconds
+    ckpt_save_start   engine, path, background/async_
+    ckpt_commit       engine, path, bytes, write_s, checksum
+    ckpt_save_blocking engine, path, step, blocking_s, final
+    ckpt_save_durable engine, wait_s
+    ckpt_restore_start/ckpt_restore_done  engine, path, seconds
+    ckpt_precheck_failed / ckpt_restore_fallback  path, reason
+    ckpt_prune        engine, count, removed
+    resume            path, step, seconds; resume_replay: replayed_steps
+    preempt_check     step, time_left_s, threshold_s
+    preempt_notice / preempt_stop / preempt_estimate
+    maintenance_event / maintenance_watcher_retired / maintenance_degraded
+    data_stall        wait_s, depth, batch
+    mfu_peak_unknown  device_kind, fallback_flops
+    run_summary       status, step, + WallTimeTotals.as_dict() (goodput)
+
+``tools/summarize_telemetry.py`` turns a run's JSONL into a goodput
+report; ``sinks.read_events`` is the tolerant read-back it builds on.
+"""
+
+from pyrecover_tpu.telemetry.bus import (
+    add_sink,
+    close,
+    emit,
+    enabled,
+    remove_sink,
+)
+from pyrecover_tpu.telemetry.sinks import (
+    JsonlSink,
+    LogSink,
+    MemorySink,
+    last_recorded_step,
+    read_events,
+)
+
+__all__ = [
+    "emit",
+    "enabled",
+    "add_sink",
+    "remove_sink",
+    "close",
+    "JsonlSink",
+    "MemorySink",
+    "LogSink",
+    "read_events",
+    "last_recorded_step",
+]

@@ -1,0 +1,96 @@
+"""The telemetry event bus: ``emit(event, **fields)`` + pluggable sinks.
+
+One process-wide bus. Producers anywhere in the stack (train loop,
+checkpoint engines, preemption watcher, data loader) call ``emit``; the
+bus stamps the envelope (``ts`` unix seconds, ``event`` name, ``host``
+process index) and fans the record out to every registered sink. With no
+sinks registered ``emit`` is a two-instruction no-op, so instrumentation
+points cost nothing on un-instrumented runs — and none of them ever
+force a device sync; every field producers pass is host-side data.
+
+Sinks are duck-typed: anything with ``write(record: dict)`` (and an
+optional ``close()``). A sink that raises is disabled after logging one
+warning — a broken disk for the telemetry file must never take down the
+training step that emitted the event. Thread safety: producers include
+background threads (async checkpoint writer, maintenance watcher, loader
+prefetch), so fan-out runs under a lock.
+"""
+
+import threading
+import time
+
+_lock = threading.RLock()
+_sinks = []
+
+
+def _process_index():
+    # Deferred import so telemetry works before jax.distributed init.
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def enabled():
+    """True when at least one sink is registered (producers may use this
+    to skip building per-event field dicts in hot paths)."""
+    return bool(_sinks)
+
+
+def add_sink(sink):
+    with _lock:
+        _sinks.append(sink)
+    return sink
+
+
+def remove_sink(sink):
+    """Detach ``sink`` (closing it if it has ``close``); missing is a no-op."""
+    with _lock:
+        try:
+            _sinks.remove(sink)
+        except ValueError:
+            return
+    close_fn = getattr(sink, "close", None)
+    if close_fn is not None:
+        close_fn()
+
+
+def close():
+    """Detach and close every sink (end of run / test teardown)."""
+    with _lock:
+        sinks, _sinks[:] = list(_sinks), []
+    for s in sinks:
+        close_fn = getattr(s, "close", None)
+        if close_fn is not None:
+            try:
+                close_fn()
+            except Exception:
+                pass
+
+
+def emit(event, /, **fields):
+    """Emit one telemetry event. Returns the record dict (or None when no
+    sink is registered). Reserved envelope keys (``ts``/``event``/``host``)
+    win over same-named fields."""
+    if not _sinks:
+        return None
+    rec = dict(fields)
+    rec["ts"] = round(time.time(), 6)
+    rec["event"] = str(event)
+    rec["host"] = _process_index()
+    with _lock:
+        for sink in list(_sinks):
+            try:
+                sink.write(rec)
+            except Exception as e:
+                _sinks.remove(sink)
+                from pyrecover_tpu.utils.logging import log_host0
+
+                log_host0(
+                    "telemetry sink %s failed (%s: %s); disabling it",
+                    type(sink).__name__, type(e).__name__, e,
+                    level=30,  # WARNING
+                )
+    return rec

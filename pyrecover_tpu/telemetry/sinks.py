@@ -1,0 +1,120 @@
+"""Telemetry sinks + the tolerant JSONL read-back used by the summarizer.
+
+``JsonlSink`` is the durable substrate: one JSON object per line, appended
+and flushed per event so a SIGTERM/preemption kill loses at most the line
+being written — the read-back side (``read_events``) therefore tolerates a
+torn final line (and any other garbage line) by skipping it, mirroring the
+loss-CSV torn-row policy in ``metrics.LossCSVLogger``.
+"""
+
+import json
+import logging
+from pathlib import Path
+
+from pyrecover_tpu.telemetry.bus import _process_index
+
+
+class JsonlSink:
+    """Host-0 JSONL file sink (one event per line, flushed per event).
+
+    ``host0_only=False`` writes on every host — useful when each host logs
+    to its own local file. ``append=False`` truncates (fresh run);
+    ``append=True`` continues an existing stream (resume), which is what
+    lets goodput accounting see the previous attempt's progress.
+    """
+
+    def __init__(self, path, *, host0_only=True, append=True):
+        self.path = Path(path)
+        self._file = None
+        if host0_only and _process_index() != 0:
+            return
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._file = open(self.path, "a" if append else "w")
+
+    def write(self, record):
+        if self._file is None:
+            return
+        self._file.write(
+            json.dumps(record, default=str, separators=(",", ":")) + "\n"
+        )
+        self._file.flush()
+
+    def close(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+class MemorySink:
+    """In-memory sink for tests: records land in ``self.events``."""
+
+    def __init__(self):
+        self.events = []
+
+    def write(self, record):
+        self.events.append(dict(record))
+
+    def close(self):
+        pass
+
+
+class LogSink:
+    """Mirror events into the host-0 text log (one compact line each)."""
+
+    def __init__(self, level=logging.INFO):
+        self.level = level
+
+    def write(self, record):
+        from pyrecover_tpu.utils.logging import log_host0
+
+        fields = " ".join(
+            f"{k}={record[k]}" for k in record
+            if k not in ("ts", "event", "host")
+        )
+        log_host0("telemetry | %s %s", record["event"], fields, level=self.level)
+
+    def close(self):
+        pass
+
+
+def read_events(path):
+    """All parseable events from a telemetry JSONL, in file order.
+
+    Torn lines (a kill mid-write), blank lines, and non-event JSON are
+    skipped, never raised — the stream is observability, not state.
+    Returns [] for a missing file.
+    """
+    path = Path(path)
+    if not path.exists():
+        return []
+    out = []
+    with open(path, "r", errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "event" in rec:
+                out.append(rec)
+    return out
+
+
+def last_recorded_step(path):
+    """Highest ``step`` field recorded in a telemetry JSONL, or None.
+
+    The resumed run uses this as the previous attempt's high-water mark:
+    steps replayed below it are counted as lost (not productive) work in
+    the goodput accounting — it survives hard kills because the JSONL is
+    flushed per event.
+    """
+    best = None
+    for rec in read_events(path):
+        step = rec.get("step")
+        if isinstance(step, (int, float)):
+            step = int(step)
+            if best is None or step > best:
+                best = step
+    return best

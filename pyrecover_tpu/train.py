@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from pyrecover_tpu import telemetry
 from pyrecover_tpu.checkpoint import (
     ShardedCheckpointer,
     checkpoint_path,
@@ -31,7 +32,11 @@ from pyrecover_tpu.metrics import LossCSVLogger, ThroughputMeter, WallTimeTotals
 from pyrecover_tpu.optim import build_optimizer
 from pyrecover_tpu.parallel.mesh import create_mesh, initialize_distributed
 from pyrecover_tpu.parallel.sharding import _leaf_rule
-from pyrecover_tpu.preempt import PreemptionWatcher, write_requeue_marker
+from pyrecover_tpu.preempt import (
+    PreemptionWatcher,
+    read_requeue_marker,
+    write_requeue_marker,
+)
 from pyrecover_tpu.train_state import (
     create_train_state,
     make_eval_step,
@@ -283,6 +288,9 @@ def _resume(config, exp_dir, state, sampler, sharded_ckptr, totals):
                     "falling back to the previous one", cand, reason,
                     level=30,  # WARNING
                 )
+                telemetry.emit(
+                    "ckpt_precheck_failed", path=str(cand), reason=reason
+                )
                 continue
             prechecked = True
         try:
@@ -315,6 +323,10 @@ def _resume(config, exp_dir, state, sampler, sharded_ckptr, totals):
                 "to the previous one", cand, type(e).__name__, e,
                 level=30,  # WARNING
             )
+            telemetry.emit(
+                "ckpt_restore_fallback", path=str(cand),
+                reason=f"{type(e).__name__}: {e}",
+            )
             continue
         start_step = int(meta.get("step", int(np.asarray(state.step))))
         sampler.seek(sampler_meta.get("consumed", start_step))
@@ -322,6 +334,10 @@ def _resume(config, exp_dir, state, sampler, sharded_ckptr, totals):
         log_host0(
             "Resumed from %s at step %d (%.2f s)", cand, start_step,
             totals.ckpt_load_s,
+        )
+        telemetry.emit(
+            "resume", path=str(cand), step=start_step,
+            seconds=round(totals.ckpt_load_s, 4),
         )
         return start_step, state
     # refuse to run: a fresh start would save new checkpoints and retention
@@ -334,11 +350,32 @@ def _resume(config, exp_dir, state, sampler, sharded_ckptr, totals):
 
 
 def train(config: TrainConfig):
+    """Run training. Thin shell around ``_train_impl`` that guarantees the
+    ``run_summary`` telemetry event (goodput accounting) is emitted and the
+    run-owned telemetry sinks are torn down on EVERY exit path — normal
+    completion, early stop, and crash (a crashed run's partial goodput
+    record is exactly what the post-mortem needs)."""
     init_logger()
     # --distributed makes a failed/absent rendezvous fatal (reference
     # dist_utils.py:64-65) instead of degrading to N divergent solo runs
     initialize_distributed(required=config.distributed)
     totals = WallTimeTotals()
+    t_entry = time.monotonic()
+    owned_sinks = []
+    status = {"status": "error", "step": 0}
+    try:
+        return _train_impl(config, totals, t_entry, owned_sinks, status)
+    finally:
+        totals.wall_s = time.monotonic() - t_entry
+        telemetry.emit(
+            "run_summary", status=status["status"], step=status["step"],
+            **totals.as_dict(),
+        )
+        for sink in owned_sinks:
+            telemetry.remove_sink(sink)
+
+
+def _train_impl(config, totals, t_entry, owned_sinks, status):
 
     # refuse a checkpoint "dir" that exists as a file (reference train.py:138-139)
     from pathlib import Path as _Path
@@ -373,6 +410,50 @@ def train(config: TrainConfig):
     log_host0("Model: %.2fM params | %s", n_params / 1e6, model_config)
 
     exp_dir = checkpoint_path(config.checkpoint_dir, config.experiment_name, 0).parent
+
+    # ---- telemetry sinks + previous attempt's progress high-water mark -----
+    # prior_step: the highest step the PREVIOUS attempt completed, recovered
+    # from the requeue/done marker (graceful stops) and the telemetry JSONL
+    # itself (flushed per event, so it survives hard kills). Post-resume
+    # steps at or below it are re-done work — the goodput accounting's
+    # replayed-step ledger.
+    prior_step = None
+    telemetry_path = None
+    resume_requested = bool(config.resume_from_checkpoint)
+    if config.telemetry:
+        telemetry_path = (
+            _Path(config.telemetry_path) if config.telemetry_path
+            else exp_dir / f"{config.experiment_name}_telemetry.jsonl"
+        )
+    if resume_requested:
+        marker = read_requeue_marker(exp_dir)
+        if marker and marker.get("step") is not None:
+            prior_step = int(marker["step"])
+        if telemetry_path is not None:
+            recorded = telemetry.last_recorded_step(telemetry_path)
+            if recorded is not None:
+                prior_step = max(prior_step or 0, recorded)
+    if telemetry_path is not None:
+        # append across resume cycles (one continuous event stream per
+        # experiment, like the loss CSV); truncate on a fresh run
+        owned_sinks.append(telemetry.add_sink(
+            telemetry.JsonlSink(telemetry_path, append=resume_requested)))
+    if config.telemetry_stdout:
+        owned_sinks.append(telemetry.add_sink(telemetry.LogSink()))
+    telemetry.emit(
+        "run_start",
+        devices=jax.device_count(),
+        device_kind=jax.devices()[0].device_kind,
+        processes=jax.process_count(),
+        mesh={k: int(v) for k, v in dict(mesh.shape).items()},
+        params_m=round(n_params / 1e6, 3),
+        batch_size=config.batch_size,
+        sequence_length=config.sequence_length,
+        grad_accum_steps=config.grad_accumulation_steps,
+        training_steps=config.training_steps,
+        resume=resume_requested,
+    )
+
     sharded_ckptr = (
         ShardedCheckpointer(use_async=config.async_checkpoint)
         if config.sharded_checkpoint
@@ -426,6 +507,11 @@ def train(config: TrainConfig):
                     max_keep=config.max_kept_checkpoints, extra_meta=extra,
                 )
         log_host0("Saved checkpoint %s in %.2f s", path.name, secs)
+        telemetry.emit(
+            "ckpt_saved", step=int(step), path=path.name, final=bool(final),
+            engine="sharded" if config.sharded_checkpoint else "vanilla",
+            blocking_s=round(secs, 4),
+        )
         return secs
 
     def sampler_epoch_of(step):
@@ -447,6 +533,13 @@ def train(config: TrainConfig):
             if sharded_ckptr is not None:
                 sharded_ckptr.close()
             raise
+    if start_step > 0 and prior_step is not None and prior_step > start_step:
+        telemetry.emit(
+            "resume_replay", start_step=start_step, prior_step=prior_step,
+            replayed_steps=prior_step - start_step,
+        )
+    else:
+        prior_step = None  # nothing to replay (fresh start / no progress record)
     loader = DataLoader(
         dataset, sampler, pad_token_id=pad_token_id, mesh=mesh,
         prefetch=2, num_workers=4,
@@ -469,6 +562,10 @@ def train(config: TrainConfig):
         for s_, l_ in pending_losses:
             csv_logger.log(s_, float(l_))
         pending_losses.clear()
+        # push the batch to the OS now: rows must not sit in the userspace
+        # buffer until close() — a SIGTERM kill would lose every row since
+        # the last sync point
+        csv_logger.flush()
 
     try:
         step_fn = make_train_step(
@@ -504,9 +601,41 @@ def train(config: TrainConfig):
         # (tiny arrays; materialized in one batch at the next sync point —
         # by then all but the newest are already computed).
         train_t0 = time.monotonic()
+        # pre-loop warmup (mesh/model init, compile staging) — part of the
+        # restart tax on a resumed run; the checkpoint load is its own bucket
+        totals.setup_s = max(train_t0 - t_entry - totals.ckpt_load_s, 0.0)
         pending_tokens = []
+        step_times = []  # (step, data_wait_s, dispatch_s) awaiting a sync point
         sync_t0 = time.monotonic()
         steps_since_sync = 0
+
+        def close_interval(now):
+            """Attribute the wall time since the last boundary to stepping
+            (goodput ledger: productive vs replayed share) and flush the
+            buffered per-step telemetry — host-side work only, no device
+            syncs. Called at sync points and before eval/checkpoint blocks
+            so their time never counts as stepping. Returns
+            ``(interval_s, steps_in_interval)`` and resets the interval."""
+            nonlocal sync_t0, steps_since_sync
+            dt = now - sync_t0
+            n = steps_since_sync
+            if n > 0:
+                totals.step_s += dt
+                if prior_step is not None:
+                    replayed = min(prior_step, step) - (step - n)
+                    if replayed > 0:
+                        totals.replayed_steps += replayed
+                        totals.replayed_s += dt * replayed / n
+            for s_, dw, dd in step_times:
+                telemetry.emit(
+                    "step_time", step=s_, data_wait_s=round(dw, 6),
+                    dispatch_s=round(dd, 6),
+                )
+            step_times.clear()
+            sync_t0 = now
+            steps_since_sync = 0
+            return dt, n
+
         with jax.sharding.set_mesh(mesh):
             while step < config.training_steps:
                 if (
@@ -517,10 +646,20 @@ def train(config: TrainConfig):
                     jax.profiler.start_trace(config.profile_dir)
                     profiling = True
 
+                iter_t0 = time.monotonic()
                 epoch, batch = next(loader)
+                t_data = time.monotonic()
                 state, metrics = step_fn(state, batch)
+                t_dispatch = time.monotonic()
                 step += 1
                 steps_since_sync += 1
+                if telemetry.enabled():
+                    # host-side timestamps only; under async dispatch
+                    # dispatch_s is the enqueue cost, not device time —
+                    # device time is the sync-interval average (train_sync)
+                    step_times.append(
+                        (step, t_data - iter_t0, t_dispatch - t_data)
+                    )
                 pending_tokens.append(metrics["n_tokens"])
                 if csv_logger.enabled:
                     pending_losses.append((step, metrics["loss"]))
@@ -528,21 +667,34 @@ def train(config: TrainConfig):
                 check_preempt = watcher.is_check_step(step)
                 want_log = step % config.logging_frequency == 0
                 if want_log or check_preempt:
+                    t_sync0 = time.monotonic()
                     loss = float(metrics["loss"])  # device sync
+                    sync_s = time.monotonic() - t_sync0
                     for t in pending_tokens:
                         meter.update(int(t), config.batch_size)
                     pending_tokens.clear()
                     flush_csv()
-                    if want_log:
-                        meter.log(step, epoch, loss)
+                    snap = meter.log(step, epoch, loss) if want_log else None
                     # honest per-step time: interval average between sync
                     # points (per-step wall time under async dispatch
                     # measures only the dispatch, except on sync steps
                     # where it spikes)
-                    now = time.monotonic()
-                    watcher.observe_iter((now - sync_t0) / steps_since_sync)
-                    sync_t0 = now
-                    steps_since_sync = 0
+                    dt, n = close_interval(time.monotonic())
+                    watcher.observe_iter(dt / n)
+                    telemetry.emit(
+                        "train_sync", step=step, loss=round(loss, 6),
+                        steps=n, interval_s=round(dt, 6),
+                        iter_s=round(dt / n, 6), sync_s=round(sync_s, 6),
+                        grad_accum_steps=config.grad_accumulation_steps,
+                    )
+                    if snap is not None:
+                        telemetry.emit(
+                            "throughput", step=step,
+                            **{
+                                k: round(v, 4) if isinstance(v, float) else v
+                                for k, v in snap.items()
+                            },
+                        )
 
                 if config.profile and step == config.profile_step_end and profiling:
                     jax.profiler.stop_trace()
@@ -550,12 +702,19 @@ def train(config: TrainConfig):
 
                 # held-out evaluation (beyond-parity)
                 if run_eval is not None and step % config.eval_frequency == 0:
+                    close_interval(time.monotonic())
+                    eval_t0 = time.monotonic()
                     eval_loss = run_eval(state)
+                    eval_s = time.monotonic() - eval_t0
+                    totals.eval_s += eval_s
                     log_host0("eval | step %d | loss %.4f", step, eval_loss)
+                    telemetry.emit(
+                        "eval", step=step, loss=round(eval_loss, 6),
+                        seconds=round(eval_s, 4),
+                    )
                     # exclude eval wall time from iter-time learning AND the
                     # throughput window (else tok/s and MFU are understated)
                     sync_t0 = time.monotonic()
-                    steps_since_sync = 0
                     meter.reset()
 
                 # periodic checkpoint (reference train.py:310-331)
@@ -564,22 +723,24 @@ def train(config: TrainConfig):
                     and step % config.checkpoint_frequency == 0
                     and step < config.training_steps
                 ):
+                    close_interval(time.monotonic())
                     secs = save_ckpt(step)
                     totals.ckpt_save_s += secs
                     watcher.observe_ckpt(secs)
                     # don't attribute checkpoint time to iteration time
                     sync_t0 = time.monotonic()
-                    steps_since_sync = 0
 
                 # time-aware stop (reference train.py:223-232, 342-375);
                 # cheap host-local notice signals are observed every step,
                 # the deadline/broadcast decision only on check steps
                 if watcher.should_stop(step):
+                    close_interval(time.monotonic())
                     secs = save_ckpt(step, final=True)
                     totals.ckpt_save_s += secs
                     stopped_early = True
                     break
 
+        close_interval(time.monotonic())  # tail interval since the last sync
         totals.train_s = time.monotonic() - train_t0
 
         # final checkpoint at completion (`latest` is always the end state)
@@ -587,6 +748,7 @@ def train(config: TrainConfig):
             secs = save_ckpt(step, final=True)
             totals.ckpt_save_s += secs
     finally:
+        status["step"] = step  # crashed runs still report how far they got
         unwinding = sys.exc_info()[0] is not None
         if profiling:
             jax.profiler.stop_trace()
@@ -614,7 +776,10 @@ def train(config: TrainConfig):
             )
         if sharded_ckptr is not None:
             sharded_ckptr.close()
-    write_requeue_marker(exp_dir, done=not stopped_early)
+    write_requeue_marker(exp_dir, done=not stopped_early, step=step)
+    status["status"] = "stopped_early" if stopped_early else "finished"
+    status["step"] = step
+    totals.wall_s = time.monotonic() - t_entry
     log_host0(
         "%s after step %d | %s",
         "Stopped early (deadline/preemption)" if stopped_early else "Finished",

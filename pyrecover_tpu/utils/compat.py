@@ -1,0 +1,121 @@
+"""Compatibility shims for older jax releases.
+
+The codebase targets the current jax sharding surface:
+``jax.sharding.set_mesh`` / ``jax.sharding.get_abstract_mesh`` (context
+mesh), top-level ``jax.shard_map``, and ``jax.lax.pcast``. Older jax
+(0.4.x) ships the same capabilities under different names — the legacy
+``with mesh:`` thread-resource context, ``jax.experimental.shard_map`` —
+or not at all (``pcast``). ``install_jax_compat()`` fills the gaps ON the
+jax modules so every call site (package code, tests, tools) keeps using
+the one modern spelling; on a current jax it is a complete no-op.
+
+Installed from ``pyrecover_tpu/__init__`` at import time, before any
+backend client exists.
+"""
+
+import contextlib
+
+
+def install_jax_compat():
+    try:
+        import jax
+    except Exception:
+        return  # no jax at all; nothing to shim
+    _shim_sharding_context(jax)
+    _shim_shard_map(jax)
+    _shim_pcast(jax)
+    _shim_axis_size(jax)
+
+
+def _shim_sharding_context(jax):
+    """``set_mesh`` / ``get_abstract_mesh`` on top of the legacy global
+    mesh context (``with mesh:`` → ``thread_resources.env.physical_mesh``).
+    ``with_sharding_constraint`` with bare PartitionSpecs resolves through
+    that same legacy context, so ``constrain()`` keeps working."""
+    s = jax.sharding
+    if not hasattr(s, "get_abstract_mesh"):
+        from jax._src import mesh as mesh_lib
+
+        def get_abstract_mesh():
+            phys = mesh_lib.thread_resources.env.physical_mesh
+            if phys is None or phys.empty:
+                return None  # callers all guard `mesh is None or mesh.empty`
+            return phys.abstract_mesh
+
+        s.get_abstract_mesh = get_abstract_mesh
+
+    if not hasattr(s, "set_mesh"):
+
+        @contextlib.contextmanager
+        def set_mesh(mesh):
+            with mesh:
+                yield mesh
+
+        s.set_mesh = set_mesh
+
+
+def _shim_shard_map(jax):
+    """Top-level ``jax.shard_map`` in terms of the legacy experimental one:
+    ``check_vma``→``check_rep``, ``axis_names={...}`` (manual axes) →
+    ``auto`` (its complement), context mesh when ``mesh`` is omitted."""
+    if hasattr(jax, "shard_map"):
+        return
+    try:
+        from jax.experimental.shard_map import shard_map as _legacy
+    except Exception:
+        return
+
+    def shard_map(f, *, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, check_rep=None, axis_names=None, auto=None):
+        if mesh is None:
+            mesh = jax.sharding.get_abstract_mesh()
+        if axis_names is not None and auto is None:
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if check_rep is None:
+            # default the checker OFF: legacy shard_map's replication
+            # checker predates sharding_constraint/pcast support and
+            # rejects valid modern programs; it is a static checker only,
+            # never semantics
+            check_rep = check_vma if check_vma is not None else False
+        kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_rep)
+        if auto:
+            kwargs["auto"] = frozenset(auto)
+        return _legacy(f, **kwargs)
+
+    jax.shard_map = shard_map
+
+
+def _shim_pcast(jax):
+    """``pcast(x, axes, to="varying")`` marks replicated values as varying
+    for the vma checker; legacy jax has no varying-type tracking (its
+    analogue is ``check_rep=False``), so the data-identity is the correct
+    lowering."""
+    if hasattr(jax.lax, "pcast"):
+        return
+
+    def pcast(x, axes=None, *, to=None):
+        return x
+
+    jax.lax.pcast = pcast
+
+
+def _shim_axis_size(jax):
+    """Static ``jax.lax.axis_size(name)`` from the legacy axis env (the
+    size is static inside shard_map, so scan lengths built from it stay
+    static)."""
+    if hasattr(jax.lax, "axis_size"):
+        return
+
+    def axis_size(axis_name):
+        from jax._src import core
+
+        env = core.get_axis_env()
+        if isinstance(axis_name, (tuple, list)):
+            size = 1
+            for n in axis_name:
+                size *= env.axis_size(n)
+            return size
+        return env.axis_size(axis_name)
+
+    jax.lax.axis_size = axis_size

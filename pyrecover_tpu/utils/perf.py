@@ -25,15 +25,37 @@ TPU_PEAK_FLOPS_BF16 = {
 
 _CPU_FALLBACK_PEAK = 1e12  # arbitrary stand-in so MFU math never divides by 0
 
+_warned_unknown_kinds = set()
+
 
 def tpu_peak_flops(device=None):
-    """Best-effort peak bf16 FLOP/s for the local accelerator."""
+    """Best-effort peak bf16 FLOP/s for the local accelerator.
+
+    An unrecognized device kind falls back to an arbitrary 1e12 — but
+    LOUDLY (one warning + telemetry event per kind per process), because
+    every MFU/TFLOP-utilization number derived from the fallback is
+    meaningless and must not be silently trusted on new hardware."""
     if device is None:
         device = jax.devices()[0]
     kind = getattr(device, "device_kind", "").lower()
     for key, peak in TPU_PEAK_FLOPS_BF16.items():
         if key in kind:
             return peak
+    if kind not in _warned_unknown_kinds:
+        _warned_unknown_kinds.add(kind)
+        from pyrecover_tpu import telemetry
+        from pyrecover_tpu.utils.logging import log_host0
+
+        log_host0(
+            "device kind %r is not in the TPU peak-FLOPs table; using the "
+            "%.0e FLOP/s stand-in — MFU/TFLOP utilization numbers for this "
+            "run are MEANINGLESS", kind, _CPU_FALLBACK_PEAK,
+            level=30,  # WARNING
+        )
+        telemetry.emit(
+            "mfu_peak_unknown", device_kind=kind,
+            fallback_flops=_CPU_FALLBACK_PEAK,
+        )
     return _CPU_FALLBACK_PEAK
 
 

@@ -78,8 +78,26 @@ def test_loss_csv_resume_drops_torn_rows(tmp_path):
 def test_walltime_totals_summary():
     t = WallTimeTotals()
     t.train_s, t.ckpt_save_s, t.ckpt_load_s = 10.0, 1.5, 0.5
+    t.eval_s = 2.5
     s = t.summary()
-    assert "10.0" in s and "1.5" in s and "0.5" in s
+    # all four buckets appear: train, ckpt save, ckpt load, eval
+    assert "10.0" in s and "1.5" in s and "0.5" in s and "eval 2.5s" in s
+    # the same four land in the run-summary telemetry payload
+    d = t.as_dict()
+    assert (d["train_s"], d["ckpt_save_s"], d["ckpt_load_s"], d["eval_s"]) == (
+        10.0, 1.5, 0.5, 2.5
+    )
+
+
+def test_loss_csv_flush_makes_rows_durable(tmp_path):
+    """flush() must push buffered rows to the OS without closing — the rows
+    a SIGTERM kill would otherwise lose."""
+    logger = LossCSVLogger(tmp_path, "exp", enabled=True)
+    logger.log(1, 2.5)
+    logger.flush()
+    rows = list(csv.reader(open(tmp_path / "exp_loss_log.csv")))
+    assert rows == [["step", "loss"], ["1", "2.5"]]  # visible pre-close
+    logger.close()
 
 
 def test_analytic_param_count_matches_init():

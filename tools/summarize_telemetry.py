@@ -1,0 +1,270 @@
+#!/usr/bin/env python
+"""Summarize a pyrecover_tpu telemetry JSONL into a goodput report.
+
+Reads the event stream a run (or a whole interrupt/resume chain — the
+stream appends across resume cycles) wrote under ``--telemetry``, and
+renders:
+
+  * per-run-segment status: steps reached, goodput %, restart tax;
+  * aggregate goodput accounting: productive train seconds vs seconds
+    lost to checkpoint save/load, restart re-warmup, and replayed steps;
+  * step-time breakdown (data-wait vs dispatch vs synced iteration time);
+  * checkpoint lifecycle totals per engine (blocking vs background);
+  * preemption / maintenance / data-stall event digests.
+
+``--json OUT`` additionally writes a BENCH-compatible blob
+(``{"metric": "goodput_pct", "value": ..., "unit": "%", "extra": {...}}``).
+
+Exit codes: 0 = report rendered, 2 = unreadable/empty stream.
+"""
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from pyrecover_tpu.telemetry import read_events  # noqa: E402
+
+
+def _fmt_s(x):
+    return f"{x:.2f}s"
+
+
+def _mean(xs):
+    return sum(xs) / len(xs) if xs else 0.0
+
+
+def segments(events):
+    """Split the stream into run segments: run_start .. run_summary."""
+    segs = []
+    cur = None
+    for e in events:
+        if e["event"] == "run_start":
+            if cur is not None:
+                segs.append(cur)  # previous segment died without a summary
+            cur = {"start": e, "events": [], "summary": None}
+        elif cur is not None:
+            cur["events"].append(e)
+            if e["event"] == "run_summary":
+                cur["summary"] = e
+                segs.append(cur)
+                cur = None
+    if cur is not None:
+        segs.append(cur)
+    return segs
+
+
+def aggregate(events):
+    """Whole-stream rollup used by both the report and the JSON blob."""
+    by = defaultdict(list)
+    for e in events:
+        by[e["event"]].append(e)
+
+    agg = {"n_events": len(events), "n_segments": 0, "segments": []}
+    total = defaultdict(float)
+    for seg in segments(events):
+        agg["n_segments"] += 1
+        s = seg["summary"]
+        row = {
+            "status": s["status"] if s else "no summary (killed?)",
+            "step": s["step"] if s else None,
+        }
+        if s:
+            for k in ("wall_s", "step_s", "productive_s", "replayed_s",
+                      "ckpt_save_s", "ckpt_load_s", "setup_s", "eval_s",
+                      "lost_s"):
+                total[k] += float(s.get(k, 0.0))
+            total["replayed_steps"] += int(s.get("replayed_steps", 0))
+            row["goodput_pct"] = s.get("goodput_pct")
+            row["replayed_steps"] = s.get("replayed_steps", 0)
+        agg["segments"].append(row)
+    agg["totals"] = dict(total)
+    agg["goodput_pct"] = (
+        round(100.0 * total["productive_s"] / total["wall_s"], 2)
+        if total.get("wall_s") else None
+    )
+
+    steps = by.get("step_time", [])
+    syncs = by.get("train_sync", [])
+    agg["steps"] = {
+        "recorded": len(steps),
+        "data_wait_s_mean": round(_mean([e["data_wait_s"] for e in steps]), 6),
+        "data_wait_s_max": round(max([e["data_wait_s"] for e in steps], default=0.0), 6),
+        "dispatch_s_mean": round(_mean([e["dispatch_s"] for e in steps]), 6),
+        "iter_s_mean": round(_mean([e["iter_s"] for e in syncs]), 6),
+        "sync_s_mean": round(_mean([e["sync_s"] for e in syncs]), 6),
+    }
+    if syncs:
+        agg["loss_first"] = syncs[0].get("loss")
+        agg["loss_last"] = syncs[-1].get("loss")
+
+    ckpt = {}
+    for e in by.get("ckpt_save_blocking", []):
+        eng = ckpt.setdefault(
+            e.get("engine", "?"),
+            {"saves": 0, "blocking_s": 0.0, "blocking_s_max": 0.0,
+             "restores": 0, "restore_s": 0.0},
+        )
+        eng["saves"] += 1
+        eng["blocking_s"] += e["blocking_s"]
+        eng["blocking_s_max"] = max(eng["blocking_s_max"], e["blocking_s"])
+    for e in by.get("ckpt_restore_done", []):
+        eng = ckpt.setdefault(
+            e.get("engine", "?"),
+            {"saves": 0, "blocking_s": 0.0, "blocking_s_max": 0.0,
+             "restores": 0, "restore_s": 0.0},
+        )
+        eng["restores"] += 1
+        eng["restore_s"] += e["seconds"]
+    for eng in ckpt.values():
+        for k in ("blocking_s", "blocking_s_max", "restore_s"):
+            eng[k] = round(eng[k], 4)
+    agg["ckpt"] = ckpt
+    agg["ckpt_commits"] = {
+        "count": len(by.get("ckpt_commit", [])),
+        "bytes": sum(e.get("bytes", 0) for e in by.get("ckpt_commit", [])),
+        "write_s": round(
+            sum(e.get("write_s", 0.0) for e in by.get("ckpt_commit", [])), 4
+        ),
+    }
+    agg["ckpt_durable_wait_s"] = round(
+        sum(e.get("wait_s", 0.0) for e in by.get("ckpt_save_durable", [])), 4
+    )
+    agg["ckpt_prunes"] = sum(e.get("count", 0) for e in by.get("ckpt_prune", []))
+    agg["ckpt_fallbacks"] = (
+        len(by.get("ckpt_precheck_failed", []))
+        + len(by.get("ckpt_restore_fallback", []))
+    )
+
+    stalls = by.get("data_stall", [])
+    agg["data_stalls"] = {
+        "count": len(stalls),
+        "wait_s": round(sum(e["wait_s"] for e in stalls), 4),
+    }
+    agg["preempt"] = {
+        "checks": len(by.get("preempt_check", [])),
+        "notices": len(by.get("preempt_notice", [])),
+        "stops": [e.get("reason", "") for e in by.get("preempt_stop", [])],
+        "maintenance": [
+            e.get("description", "") for e in by.get("maintenance_event", [])
+        ],
+    }
+    agg["warnings"] = [
+        f"MFU denominator unknown for device kind {e.get('device_kind')!r}"
+        for e in by.get("mfu_peak_unknown", [])
+    ]
+    return agg
+
+
+def render(agg, out=None):
+    w = (out or sys.stdout).write
+    t = agg["totals"]
+    w(f"telemetry summary: {agg['n_events']} events, "
+      f"{agg['n_segments']} run segment(s)\n")
+    w("\n-- run segments ------------------------------------------------\n")
+    for i, seg in enumerate(agg["segments"]):
+        good = (
+            f" | goodput {seg['goodput_pct']:.1f}%"
+            if seg.get("goodput_pct") is not None else ""
+        )
+        rep = (
+            f" | replayed {seg['replayed_steps']} steps"
+            if seg.get("replayed_steps") else ""
+        )
+        w(f"  [{i}] {seg['status']} at step {seg['step']}{good}{rep}\n")
+    if t:
+        w("\n-- goodput accounting (all segments) ---------------------------\n")
+        w(f"  wall time          {_fmt_s(t.get('wall_s', 0.0))}\n")
+        w(f"  productive train   {_fmt_s(t.get('productive_s', 0.0))}"
+          f"  <- stepping time that moved training forward once\n")
+        w(f"  lost: ckpt save    {_fmt_s(t.get('ckpt_save_s', 0.0))}\n")
+        w(f"  lost: ckpt load    {_fmt_s(t.get('ckpt_load_s', 0.0))}\n")
+        w(f"  lost: re-warmup    {_fmt_s(t.get('setup_s', 0.0))}\n")
+        w(f"  lost: replayed     {_fmt_s(t.get('replayed_s', 0.0))}"
+          f"  ({int(t.get('replayed_steps', 0))} steps re-done after resume)\n")
+        w(f"  eval               {_fmt_s(t.get('eval_s', 0.0))}\n")
+        if agg["goodput_pct"] is not None:
+            w(f"  GOODPUT            {agg['goodput_pct']:.1f}%\n")
+    st = agg["steps"]
+    if st["recorded"]:
+        w("\n-- step-time breakdown -----------------------------------------\n")
+        w(f"  steps recorded     {st['recorded']}\n")
+        w(f"  data wait          mean {st['data_wait_s_mean'] * 1e3:.2f}ms"
+          f"  max {st['data_wait_s_max'] * 1e3:.2f}ms\n")
+        w(f"  dispatch           mean {st['dispatch_s_mean'] * 1e3:.2f}ms\n")
+        w(f"  synced iter time   mean {st['iter_s_mean'] * 1e3:.2f}ms"
+          f"  (sync cost mean {st['sync_s_mean'] * 1e3:.2f}ms)\n")
+        if "loss_first" in agg:
+            w(f"  loss               {agg['loss_first']} -> {agg['loss_last']}\n")
+    if agg["ckpt"]:
+        w("\n-- checkpoint lifecycle ----------------------------------------\n")
+        for eng, c in sorted(agg["ckpt"].items()):
+            w(f"  [{eng}] {c['saves']} saves, blocking {c['blocking_s']}s "
+              f"(max {c['blocking_s_max']}s); {c['restores']} restores, "
+              f"{c['restore_s']}s\n")
+        cm = agg["ckpt_commits"]
+        if cm["count"]:
+            w(f"  commits: {cm['count']} ({cm['bytes']} bytes, "
+              f"{cm['write_s']}s background write)\n")
+        if agg["ckpt_durable_wait_s"]:
+            w(f"  durability waits: {agg['ckpt_durable_wait_s']}s\n")
+        if agg["ckpt_prunes"]:
+            w(f"  pruned: {agg['ckpt_prunes']} old checkpoint(s)\n")
+        if agg["ckpt_fallbacks"]:
+            w(f"  RESTORE FALLBACKS: {agg['ckpt_fallbacks']} "
+              f"(corrupt/torn candidates skipped)\n")
+    ds = agg["data_stalls"]
+    if ds["count"]:
+        w(f"\n-- data loader: {ds['count']} stall(s), {ds['wait_s']}s waiting "
+          f"on host-side tokenize/collate\n")
+    pre = agg["preempt"]
+    if pre["checks"] or pre["notices"] or pre["stops"] or pre["maintenance"]:
+        w("\n-- preemption / maintenance ------------------------------------\n")
+        w(f"  deadline checks {pre['checks']} | notices {pre['notices']}\n")
+        for r in pre["stops"]:
+            w(f"  STOP: {r}\n")
+        for d in pre["maintenance"]:
+            w(f"  MAINTENANCE: {d}\n")
+    for warning in agg["warnings"]:
+        w(f"\n  WARNING: {warning}\n")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("path", help="telemetry JSONL file")
+    p.add_argument("--json", dest="json_out", default=None,
+                   help="also write a BENCH-compatible JSON blob here")
+    args = p.parse_args(argv)
+
+    events = read_events(args.path)
+    if not events:
+        print(f"error: no telemetry events readable from {args.path}",
+              file=sys.stderr)
+        return 2
+    agg = aggregate(events)
+    render(agg)
+    if args.json_out:
+        blob = {
+            "metric": "goodput_pct",
+            "value": agg["goodput_pct"],
+            "unit": "%",
+            "extra": {
+                "segments": agg["segments"],
+                "totals": agg["totals"],
+                "steps": agg["steps"],
+                "ckpt": agg["ckpt"],
+                "data_stalls": agg["data_stalls"],
+                "preempt": agg["preempt"],
+            },
+        }
+        with open(args.json_out, "w") as f:
+            json.dump(blob, f, indent=2)
+        print(f"\nwrote {args.json_out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
