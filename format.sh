@@ -34,4 +34,13 @@ if python -c "import flake8" 2>/dev/null; then
 else
   echo "flake8 not installed; skipped"
 fi
+
+# jaxlint: JAX-aware static analysis (pyrecover_tpu/analysis — pure stdlib,
+# always available). --strict fails on any unsuppressed finding: this is the
+# CI gate that keeps host syncs / PRNG reuse / donation bugs out of the hot
+# path. The JSON report (path overridable via JAXLINT_JSON) gives CI tooling
+# the same machine-readable surface as tools/summarize_telemetry.py.
+python tools/jaxlint.py pyrecover_tpu tools bench.py __graft_entry__.py \
+  --strict --json "${JAXLINT_JSON:-/tmp/jaxlint_report.json}" || rc=1
+
 exit $rc

@@ -47,6 +47,9 @@ def _honor_jax_platforms_env():
         # bare silent except) is what surfaces the regression — a silent
         # no-op here reintroduces the hang-on-dead-tunnel mode this fixup
         # exists to prevent.
+        # jaxlint: disable-next=legacy-jax-spelling -- there is no public
+        # "is a backend client live" API; the probe is pinned by
+        # tests/test_package.py exactly so a rename surfaces loudly
         import jax._src.xla_bridge as _xb
 
         if _xb._backends:

@@ -1,0 +1,244 @@
+"""Project-wide function index and hot-path reachability.
+
+The host-sync rule needs to know which functions are "hot" — reachable
+from the training step's driver loop — without executing anything. The
+index records every function/method definition across the linted modules
+plus each module's import aliases; reachability then walks call edges:
+
+  * ``name(...)``          → nearest enclosing def scope, then module
+    scope, then ``from x import name`` targets resolved into the project.
+  * ``alias.attr(...)``    → project module when ``alias`` is an import
+    alias for it.
+  * ``obj.method(...)``    → *fuzzy* edge: resolved only when exactly one
+    project function bears that method name and the name is not in the
+    generic-method blacklist (``.get``/``.update``/… would connect
+    everything to everything).
+
+Functions marked ``# jaxlint: sync-point`` (deliberate host-sync
+boundaries) or ``# jaxlint: host-only`` (touch no device values at all)
+stop reachability at their door. Jitted functions are device code —
+host-sync syntax inside them fails loudly at trace time, so they are
+excluded from the *host*-sync hot set too.
+"""
+
+import ast
+
+JIT_DOTTED = {"jax.jit", "jit"}
+PARTIAL_DOTTED = {"partial", "functools.partial"}
+
+
+def dotted_name(node):
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class FunctionInfo:
+    def __init__(self, module, node, qualname, parent=None, is_method=False):
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.qualname = qualname
+        self.parent = parent  # enclosing FunctionInfo, if nested
+        self.is_method = is_method
+        self.is_jit = False
+        self.markers = module.function_markers(node)
+
+    def __repr__(self):
+        return f"<fn {self.module.relpath}::{self.qualname}>"
+
+
+class ProjectIndex:
+    def __init__(self, modules):
+        self.modules = list(modules)
+        self.functions = []
+        self.by_module = {}  # ModuleInfo -> [FunctionInfo]
+        self.by_name = {}  # bare name -> [FunctionInfo]
+        self.by_node = {}  # ast node -> FunctionInfo
+        self.import_aliases = {}  # ModuleInfo -> {alias: dotted module}
+        self.from_imports = {}  # ModuleInfo -> {local name: (module, orig)}
+        for m in self.modules:
+            self._index_module(m)
+        for m in self.modules:
+            self._mark_jit(m)
+        # nested functions of a jitted function are traced too
+        for fn in self.functions:
+            cur = fn.parent
+            while cur is not None and not fn.is_jit:
+                fn.is_jit = fn.is_jit or cur.is_jit
+                cur = cur.parent
+
+    # ---- indexing ----------------------------------------------------------
+
+    def _index_module(self, module):
+        funcs = []
+        aliases, froms = {}, {}
+
+        def visit(node, qual_prefix, parent_fn, in_class):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = (
+                        f"{qual_prefix}.{child.name}" if qual_prefix
+                        else child.name
+                    )
+                    fi = FunctionInfo(
+                        module, child, qual, parent=parent_fn,
+                        is_method=in_class,
+                    )
+                    funcs.append(fi)
+                    self.by_node[child] = fi
+                    visit(child, qual, fi, False)
+                elif isinstance(child, ast.ClassDef):
+                    qual = (
+                        f"{qual_prefix}.{child.name}" if qual_prefix
+                        else child.name
+                    )
+                    visit(child, qual, parent_fn, True)
+                elif isinstance(child, ast.Import):
+                    for a in child.names:
+                        aliases[a.asname or a.name.split(".")[0]] = a.name
+                elif isinstance(child, ast.ImportFrom):
+                    for a in child.names:
+                        froms[a.asname or a.name] = (child.module or "", a.name)
+                    visit(child, qual_prefix, parent_fn, in_class)
+                else:
+                    visit(child, qual_prefix, parent_fn, in_class)
+
+        visit(module.tree, "", None, False)
+        self.by_module[module] = funcs
+        self.functions.extend(funcs)
+        for fi in funcs:
+            self.by_name.setdefault(fi.name, []).append(fi)
+        self.import_aliases[module] = aliases
+        self.from_imports[module] = froms
+
+    def _mark_jit(self, module):
+        froms = self.from_imports[module]
+
+        def is_jit_expr(expr):
+            d = dotted_name(expr)
+            if d in JIT_DOTTED:
+                return froms.get("jit", ("", ""))[0] == "jax" if d == "jit" else True
+            return False
+
+        for fi in self.by_module[module]:
+            for dec in fi.node.decorator_list:
+                if is_jit_expr(dec):
+                    fi.is_jit = True
+                elif isinstance(dec, ast.Call):
+                    d = dotted_name(dec.func)
+                    if d in JIT_DOTTED and is_jit_expr(dec.func):
+                        fi.is_jit = True
+                    elif d in PARTIAL_DOTTED and dec.args and is_jit_expr(
+                        dec.args[0]
+                    ):
+                        fi.is_jit = True
+        # jax.jit(f, ...) somewhere in the module marks local def f
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call) and is_jit_expr(node.func)):
+                continue
+            if node.args and isinstance(node.args[0], ast.Name):
+                target = self.resolve_local(module, node, node.args[0].id)
+                if target is not None:
+                    target.is_jit = True
+
+    # ---- resolution --------------------------------------------------------
+
+    def resolve_local(self, module, at_node, name):
+        """Resolve a bare name at ``at_node`` to a FunctionInfo: nearest
+        enclosing def scope outward, then module scope, then from-imports."""
+        scope = module.enclosing_function(at_node)
+        while scope is not None:
+            for fi in self.by_module[module]:
+                if fi.name == name and fi.parent is not None and \
+                        fi.parent.node is scope:
+                    return fi
+            scope = module.enclosing_function(scope)
+        for fi in self.by_module[module]:
+            if fi.name == name and fi.parent is None:
+                return fi
+        imp = self.from_imports[module].get(name)
+        if imp is not None:
+            mod_dotted, orig = imp
+            return self._project_function(mod_dotted, orig)
+        return None
+
+    def _project_function(self, mod_dotted, name):
+        """Find ``name`` at module level of a project module whose path
+        matches the dotted module name."""
+        if not mod_dotted:
+            return None
+        tail = mod_dotted.replace(".", "/") + ".py"
+        init_tail = mod_dotted.replace(".", "/") + "/__init__.py"
+        for m in self.modules:
+            rel = str(m.relpath).replace("\\", "/")
+            if rel.endswith(tail) or rel.endswith(init_tail):
+                for fi in self.by_module[m]:
+                    if fi.name == name and fi.parent is None:
+                        return fi
+        return None
+
+    def resolve_call(self, module, call, config):
+        """Best-effort resolution of a Call's callee to a FunctionInfo."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            return self.resolve_local(module, call, func.id)
+        if isinstance(func, ast.Attribute):
+            d = dotted_name(func)
+            if d is not None:
+                base, _, attr = d.rpartition(".")
+                target_mod = self.import_aliases[module].get(base)
+                if target_mod is not None:
+                    return self._project_function(target_mod, attr)
+            # fuzzy method edge
+            attr = func.attr
+            if (
+                len(attr) > 3
+                and attr not in config.fuzzy_method_blacklist
+                and len(self.by_name.get(attr, ())) == 1
+            ):
+                return self.by_name[attr][0]
+        return None
+
+
+def build_hot_set(index, config):
+    """BFS over call edges from the hot seeds; returns a set of
+    FunctionInfo. Jitted functions and ``sync-point``-marked functions are
+    never entered."""
+    seeds = []
+    for fn in index.functions:
+        if fn.name in config.hot_seeds or "hot-loop" in fn.markers:
+            seeds.append(fn)
+    hot, queue = set(), list(seeds)
+    pruning = {"sync-point", "host-only"}
+    while queue:
+        fn = queue.pop()
+        if fn in hot or fn.is_jit or (fn.markers & pruning):
+            continue
+        hot.add(fn)
+        # calls lexically inside this function but NOT inside one of its
+        # nested defs (those get walked when/if the nested def is enqueued)
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call):
+                encl = fn.module.enclosing_function(node)
+                if encl is not fn.node:
+                    continue
+                target = index.resolve_call(fn.module, node, config)
+                if target is not None:
+                    queue.append(target)
+            elif (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node is not fn.node
+                and fn.module.enclosing_function(node) is fn.node
+            ):
+                # nested defs (closures over the hot loop) are hot as well
+                nested = index.by_node.get(node)
+                if nested is not None:
+                    queue.append(nested)
+    return hot

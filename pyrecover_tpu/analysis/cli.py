@@ -1,0 +1,108 @@
+"""jaxlint command line (the engine behind ``tools/jaxlint.py``).
+
+Exit codes: 0 clean (or report-only mode), 1 unsuppressed findings under
+``--strict``, 2 usage/engine error.
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+from pyrecover_tpu.analysis.engine import (
+    DEFAULT_CONFIG,
+    LintConfig,
+    lint_paths,
+)
+from pyrecover_tpu.analysis.report import render_json, render_text
+
+
+def _build_parser():
+    p = argparse.ArgumentParser(
+        prog="jaxlint",
+        description=(
+            "JAX-aware static analysis: host syncs in the hot loop, PRNG "
+            "key reuse, donated-buffer reads, traced-value branching, side "
+            "effects under jit, non-hashable static args, unsynced timing "
+            "spans, legacy jax spellings."
+        ),
+    )
+    p.add_argument(
+        "paths", nargs="*", default=["pyrecover_tpu"],
+        help="files or directories to lint (default: pyrecover_tpu)",
+    )
+    p.add_argument(
+        "--strict", action="store_true",
+        help="exit non-zero on any unsuppressed finding (the CI gate)",
+    )
+    p.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="stdout format",
+    )
+    p.add_argument(
+        "--json", metavar="PATH", default=None,
+        help="also write the JSON report to PATH (works with --format text)",
+    )
+    p.add_argument(
+        "--select", default=None, metavar="RULES",
+        help="comma-separated rule names/ids to run (default: all)",
+    )
+    p.add_argument(
+        "--ignore", default=None, metavar="RULES",
+        help="comma-separated rule names/ids to skip",
+    )
+    p.add_argument(
+        "--show-suppressed", action="store_true",
+        help="include suppressed findings (with justifications) in text "
+        "output",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    return p
+
+
+def _csv_set(raw):
+    return frozenset(x.strip() for x in raw.split(",") if x.strip())
+
+
+def main(argv=None):
+    args = _build_parser().parse_args(argv)
+
+    from pyrecover_tpu.analysis.rules import RULES
+
+    if args.list_rules:
+        for r in RULES.values():
+            print(f"{r.id}  {r.name:<24} {r.severity:<7} {r.summary}")
+        return 0
+
+    config = DEFAULT_CONFIG
+    if args.select or args.ignore:
+        config = LintConfig(
+            select=_csv_set(args.select) if args.select else None,
+            ignore=_csv_set(args.ignore) if args.ignore else frozenset(),
+        )
+
+    missing = [p for p in args.paths if not Path(p).exists()]
+    if missing:
+        print(f"jaxlint: no such path: {', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    result = lint_paths(args.paths, config)
+
+    if args.json:
+        Path(args.json).write_text(
+            render_json(result, strict=args.strict) + "\n", encoding="utf-8"
+        )
+    if args.format == "json":
+        print(render_json(result, strict=args.strict))
+    else:
+        print(render_text(result, show_suppressed=args.show_suppressed))
+
+    if args.strict and result.unsuppressed:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

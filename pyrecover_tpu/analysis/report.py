@@ -1,0 +1,66 @@
+"""jaxlint reporters: human text and machine-readable JSON.
+
+The JSON shape mirrors ``tools/summarize_telemetry.py``'s convention —
+a single top-level object with a ``summary`` block plus the full record
+list — so CI tooling can consume both with the same plumbing.
+"""
+
+import json
+
+JSON_SCHEMA_VERSION = 1
+
+
+def summarize(result):
+    by_rule = {}
+    for f in result.findings:
+        bucket = by_rule.setdefault(
+            f.rule, {"unsuppressed": 0, "suppressed": 0}
+        )
+        bucket["suppressed" if f.suppressed else "unsuppressed"] += 1
+    return {
+        "files_scanned": result.files_scanned,
+        "findings": len(result.findings),
+        "unsuppressed": len(result.unsuppressed),
+        "suppressed": len(result.suppressed),
+        "errors": sum(
+            1 for f in result.unsuppressed if f.severity == "error"
+        ),
+        "warnings": sum(
+            1 for f in result.unsuppressed if f.severity == "warning"
+        ),
+        "by_rule": by_rule,
+    }
+
+
+def render_text(result, show_suppressed=False):
+    lines = []
+    for f in result.findings:
+        if f.suppressed and not show_suppressed:
+            continue
+        tag = "suppressed" if f.suppressed else f.severity
+        lines.append(
+            f"{f.location()}: {tag} {f.rule_id}({f.rule}) {f.message}"
+        )
+        if f.suppressed and f.justification:
+            lines.append(f"    justification: {f.justification}")
+    s = summarize(result)
+    lines.append(
+        f"{s['unsuppressed']} finding(s) "
+        f"({s['errors']} error, {s['warnings']} warning), "
+        f"{s['suppressed']} suppressed, {s['files_scanned']} file(s) scanned"
+    )
+    return "\n".join(lines)
+
+
+def render_json(result, strict=False):
+    return json.dumps(
+        {
+            "tool": "jaxlint",
+            "schema_version": JSON_SCHEMA_VERSION,
+            "strict": bool(strict),
+            "summary": summarize(result),
+            "findings": [f.as_dict() for f in result.findings],
+        },
+        indent=2,
+        sort_keys=False,
+    )

@@ -248,6 +248,9 @@ def initialize_distributed(coordinator_address=None, num_processes=None,
     # IMPORTANT: don't touch jax.devices()/process_count() here — that would
     # initialize the local backend and make distributed init impossible.
     try:
+        # jaxlint: disable-next=legacy-jax-spelling -- jax 0.4.x has no
+        # public jax.distributed.is_initialized(); guarded by try/except
+        # so a private-API rename degrades to re-init, not a crash
         from jax._src import distributed as _dist
 
         if getattr(_dist.global_state, "client", None) is not None:

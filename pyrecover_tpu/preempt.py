@@ -272,7 +272,7 @@ def write_requeue_marker(exp_dir, *, done=False, step=None):
     marker.write_text(json.dumps(payload))
 
 
-def read_requeue_marker(exp_dir):
+def read_requeue_marker(exp_dir):  # jaxlint: host-only
     """Parse whichever marker (REQUEUE or DONE) exists. Returns a dict
     (``{"ts", "done", "step"?}``) or None. Tolerates the legacy bare-float
     format and torn/garbage content — markers are advisory."""

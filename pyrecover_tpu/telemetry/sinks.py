@@ -102,7 +102,7 @@ def read_events(path):
     return out
 
 
-def last_recorded_step(path):
+def last_recorded_step(path):  # jaxlint: host-only
     """Highest ``step`` field recorded in a telemetry JSONL, or None.
 
     The resumed run uses this as the previous attempt's high-water mark:

@@ -1,0 +1,422 @@
+"""jaxlint: every rule fires on a known-bad fixture and stays quiet on the
+clean/suppressed twin; the shipped package itself must lint clean; the CLI
+and JSON reporter keep their contracts (tooling parity with
+tools/summarize_telemetry.py)."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from pyrecover_tpu.analysis import (
+    RULES,
+    LintConfig,
+    lint_paths,
+    lint_source,
+    render_json,
+)
+from pyrecover_tpu.analysis.engine import ModuleInfo, run_rules
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def names(result, only_unsuppressed=True):
+    fs = result.unsuppressed if only_unsuppressed else result.findings
+    return [f.rule for f in fs]
+
+
+# ---------------------------------------------------------------------------
+# rule fixtures: (rule name, firing snippet, clean snippet)
+# ---------------------------------------------------------------------------
+
+RULE_FIXTURES = {
+    "host-sync-in-hot-loop": (
+        """
+import jax
+
+def _train_impl(loader, step_fn, state):
+    while True:
+        batch = next(loader)
+        state, metrics = step_fn(state, batch)
+        loss = float(metrics["loss"])
+""",
+        """
+import jax
+
+def _train_impl(loader, step_fn, state):
+    pending = []
+    while True:
+        batch = next(loader)
+        state, metrics = step_fn(state, batch)
+        pending.append(metrics["loss"])
+    return pending
+""",
+    ),
+    "prng-key-reuse": (
+        """
+import jax
+
+def sample(key):
+    a = jax.random.normal(key, (2,))
+    b = jax.random.uniform(key, (2,))
+    return a, b
+""",
+        """
+import jax
+
+def sample(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (2,))
+    b = jax.random.uniform(k2, (2,))
+    return a, b
+""",
+    ),
+    "donated-buffer-reuse": (
+        """
+import jax
+
+def run(step, state, batch):
+    g = jax.jit(step, donate_argnums=(0,))
+    new_state = g(state, batch)
+    return new_state, state.params
+""",
+        """
+import jax
+
+def run(step, state, batch):
+    g = jax.jit(step, donate_argnums=(0,))
+    state = g(state, batch)
+    return state, state.params
+""",
+    ),
+    "traced-python-branch": (
+        """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    y = jnp.sum(x)
+    if y > 0:
+        return y
+    return -y
+""",
+        """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    y = jnp.sum(x)
+    return jnp.where(y > 0, y, -y)
+""",
+    ),
+    "side-effect-in-jit": (
+        """
+import jax
+import time
+
+@jax.jit
+def f(x):
+    print("tracing", x)
+    t = time.time()
+    return x, t
+""",
+        """
+import jax
+
+@jax.jit
+def f(x):
+    jax.debug.print("value {}", x)
+    return x
+""",
+    ),
+    "nonhashable-static-arg": (
+        """
+import jax
+
+def build(f):
+    h = jax.jit(f, static_argnums=(1,))
+    return h(1, [2, 3])
+""",
+        """
+import jax
+
+def build(f):
+    h = jax.jit(f, static_argnums=(1,))
+    return h(1, (2, 3))
+""",
+    ),
+    "untimed-device-work": (
+        """
+import time
+import jax.numpy as jnp
+
+def bench(x):
+    t0 = time.perf_counter()
+    y = jnp.dot(x, x)
+    dt = time.perf_counter() - t0
+    return y, dt
+""",
+        """
+import time
+import jax
+import jax.numpy as jnp
+
+def bench(x):
+    t0 = time.perf_counter()
+    y = jax.block_until_ready(jnp.dot(x, x))
+    dt = time.perf_counter() - t0
+    return y, dt
+""",
+    ),
+    "legacy-jax-spelling": (
+        """
+from jax.experimental.shard_map import shard_map
+
+def wrap(f, mesh, specs):
+    return shard_map(f, mesh=mesh, in_specs=specs, out_specs=specs)
+""",
+        """
+import jax
+
+def wrap(f, mesh, specs):
+    return jax.shard_map(f, mesh=mesh, in_specs=specs, out_specs=specs)
+""",
+    ),
+}
+
+
+@pytest.mark.parametrize("rule_name", sorted(RULE_FIXTURES))
+def test_rule_fires_on_bad_snippet(rule_name):
+    bad, _ = RULE_FIXTURES[rule_name]
+    result = lint_source(bad)
+    assert rule_name in names(result), (
+        f"{rule_name} must fire on its fixture; got {names(result)}"
+    )
+
+
+@pytest.mark.parametrize("rule_name", sorted(RULE_FIXTURES))
+def test_rule_quiet_on_clean_snippet(rule_name):
+    _, good = RULE_FIXTURES[rule_name]
+    result = lint_source(good)
+    assert rule_name not in names(result), (
+        f"{rule_name} false-positives on its clean fixture: "
+        f"{[f.message for f in result.unsuppressed]}"
+    )
+
+
+@pytest.mark.parametrize("rule_name", sorted(RULE_FIXTURES))
+def test_rule_suppressible_inline(rule_name):
+    """Appending an inline suppression to the firing line silences the rule
+    (the finding is still recorded, flagged suppressed, with justification)."""
+    bad, _ = RULE_FIXTURES[rule_name]
+    result = lint_source(bad)
+    target = next(f for f in result.findings if f.rule == rule_name)
+    lines = bad.splitlines()
+    lines[target.line - 1] += (
+        f"  # jaxlint: disable={rule_name} -- fixture-sanctioned"
+    )
+    suppressed = lint_source("\n".join(lines))
+    # the targeted line no longer gates (other lines of the fixture may)
+    assert not any(
+        f.rule == rule_name and f.line == target.line
+        for f in suppressed.unsuppressed
+    )
+    rec = next(
+        f for f in suppressed.findings
+        if f.rule == rule_name and f.line == target.line
+    )
+    assert rec.suppressed and rec.justification == "fixture-sanctioned"
+
+
+def test_every_catalog_rule_has_a_fixture():
+    assert set(RULE_FIXTURES) == set(RULES), (
+        "each rule ships with a true-positive + clean fixture pair"
+    )
+
+
+# ---------------------------------------------------------------------------
+# suppression / marker machinery
+# ---------------------------------------------------------------------------
+
+
+def test_disable_next_skips_comment_continuation():
+    src = """
+import jax
+
+def sample(key):
+    a = jax.random.normal(key, (2,))
+    # jaxlint: disable-next=prng-key-reuse -- the justification wraps
+    # over a second comment line before the code it suppresses
+    b = jax.random.uniform(key, (2,))
+    return a, b
+"""
+    result = lint_source(src)
+    assert names(result) == []
+    rec = next(f for f in result.findings if f.rule == "prng-key-reuse")
+    assert "wraps over a second comment line" in rec.justification
+
+
+def test_disable_file_suppresses_everything_in_module():
+    src = """
+# jaxlint: disable-file=prng-key-reuse -- generator module, keys reused on purpose
+import jax
+
+def sample(key):
+    a = jax.random.normal(key, (2,))
+    b = jax.random.uniform(key, (2,))
+    return a, b
+"""
+    result = lint_source(src)
+    assert names(result) == []
+    assert all(f.suppressed for f in result.findings)
+
+
+def test_suppression_on_multiline_statement_opening_line():
+    src = """
+import jax
+
+def _train_impl(loader, step_fn, state):
+    while True:
+        state, metrics = step_fn(state, next(loader))
+        loss = float(  # jaxlint: disable=host-sync-in-hot-loop -- deliberate
+            metrics["loss"]
+        )
+"""
+    assert names(lint_source(src)) == []
+
+
+def test_sync_point_marker_prunes_reachability():
+    src = """
+def _train_impl(batches, state):
+    while batches:
+        state = checkpoint(state)
+
+def checkpoint(state):  # jaxlint: sync-point
+    for leaf in state:
+        host = float(leaf)
+    return state
+"""
+    assert names(lint_source(src)) == []
+
+
+def test_hot_loop_marker_seeds_reachability():
+    src = """
+def poll(readings):  # jaxlint: hot-loop
+    out = []
+    for r in readings:
+        out.append(r.item())
+    return out
+"""
+    assert names(lint_source(src)) == ["host-sync-in-hot-loop"]
+
+
+def test_hot_reachability_crosses_modules():
+    """_train_impl in one module calls a helper in another; a loop sync in
+    the helper is attributed there."""
+    helper = ModuleInfo(
+        "pkg/helper.py",
+        """
+def drain(pending):
+    return [p * 2 for p in pending]
+
+
+def tally(pending):
+    total = 0
+    while pending:
+        q = pending.pop()
+        total += int(q)
+    return total
+""",
+        relpath="pkg/helper.py",
+    )
+    driver = ModuleInfo(
+        "pkg/driver.py",
+        """
+from pkg.helper import tally
+
+def _train_impl(pending):
+    while pending:
+        tally(pending)
+""",
+        relpath="pkg/driver.py",
+    )
+    findings = run_rules([driver, helper])
+    hot = [f for f in findings if f.rule == "host-sync-in-hot-loop"]
+    assert [f.path for f in hot] == ["pkg/helper.py"]
+    assert hot[0].line == 10  # the while-loop int() in tally
+
+
+def test_select_and_ignore_config():
+    bad = RULE_FIXTURES["prng-key-reuse"][0]
+    only_other = lint_source(
+        bad, config=LintConfig(select=frozenset({"host-sync-in-hot-loop"}))
+    )
+    assert only_other.findings == []
+    ignored = lint_source(
+        bad, config=LintConfig(ignore=frozenset({"JX02"}))
+    )
+    assert ignored.findings == []
+
+
+# ---------------------------------------------------------------------------
+# the package itself is the ultimate fixture
+# ---------------------------------------------------------------------------
+
+
+def test_shipped_package_lints_clean():
+    result = lint_paths([str(REPO / "pyrecover_tpu")])
+    offenders = [
+        f"{f.location()} {f.rule}: {f.message}" for f in result.unsuppressed
+    ]
+    assert offenders == [], "\n".join(offenders)
+    # suppressions are only honored as documentation: each must say WHY
+    for f in result.suppressed:
+        assert f.justification, (
+            f"{f.location()}: suppression without a justification"
+        )
+
+
+# ---------------------------------------------------------------------------
+# reporters + CLI (the format.sh / CI surface)
+# ---------------------------------------------------------------------------
+
+
+def test_json_report_shape():
+    bad = RULE_FIXTURES["traced-python-branch"][0]
+    result = lint_source(bad)
+    doc = json.loads(render_json(result, strict=True))
+    assert doc["tool"] == "jaxlint" and doc["strict"] is True
+    assert doc["summary"]["unsuppressed"] >= 1
+    assert doc["summary"]["by_rule"]["traced-python-branch"]["unsuppressed"] >= 1
+    f = doc["findings"][0]
+    assert {"rule", "rule_id", "severity", "path", "line", "col",
+            "message", "suppressed", "justification"} <= set(f)
+
+
+def test_cli_strict_gate(tmp_path):
+    from pyrecover_tpu.analysis.cli import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(RULE_FIXTURES["side-effect-in-jit"][0])
+    json_out = tmp_path / "report.json"
+    assert main([str(bad), "--strict", "--json", str(json_out)]) == 1
+    doc = json.loads(json_out.read_text())
+    assert doc["summary"]["unsuppressed"] >= 1
+    assert main([str(bad)]) == 0  # report-only mode never gates
+    assert main([str(tmp_path / "missing.py"), "--strict"]) == 2
+    assert main(["--list-rules"]) == 0
+
+
+def test_cli_strict_clean_on_repo_subprocess():
+    """The exact invocation format.sh and the acceptance criteria run."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "jaxlint.py"),
+         str(REPO / "pyrecover_tpu"), "--strict"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout

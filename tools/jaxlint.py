@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""jaxlint CLI — JAX-aware static analysis with a CI gate.
+
+Usage:
+    python tools/jaxlint.py pyrecover_tpu/ --strict
+    python tools/jaxlint.py --list-rules
+    python tools/jaxlint.py pyrecover_tpu/ --json /tmp/jaxlint.json
+
+All logic lives in ``pyrecover_tpu.analysis`` (rules in ``rules.py``,
+suppression syntax in ``engine.py``); this file is the executable shim so
+the linter is runnable before the package is installed.
+"""
+
+import sys
+from pathlib import Path
+
+# runnable from any cwd, installed or not
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from pyrecover_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
