@@ -43,4 +43,18 @@ fi
 python tools/jaxlint.py pyrecover_tpu tools bench.py __graft_entry__.py \
   --strict --json "${JAXLINT_JSON:-/tmp/jaxlint_report.json}" || rc=1
 
+# shardcheck: abstract SPMD preflight (pyrecover_tpu/analysis/shardcheck).
+# Every shipped preset must validate clean — partition-spec divisibility,
+# axis use, replication, collective census — on 1/2/4/8-device virtual
+# meshes, entirely on CPU (the tool forces JAX_PLATFORMS=cpu + virtual
+# devices itself). JSON report published next to the jaxlint one.
+if SHARDCHECK_OUT=$(JAX_PLATFORMS=cpu python tools/shardcheck.py \
+    --all-presets --strict \
+    --json "${SHARDCHECK_JSON:-/tmp/shardcheck_report.json}" 2>&1); then
+  echo "$SHARDCHECK_OUT" | tail -1   # clean: one summary line
+else
+  echo "$SHARDCHECK_OUT"             # findings: full report
+  rc=1
+fi
+
 exit $rc

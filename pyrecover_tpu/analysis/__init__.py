@@ -4,9 +4,13 @@ Generic linters cannot see the invariants this framework's correctness
 and speed hinge on: no hidden host↔device syncs inside the hot loop, no
 PRNG key reuse, no reads of donated buffers, no Python branching on
 traced values or side effects under ``jit``, no unhashable static args,
-no timing spans that measure async dispatch instead of device work, and
-no legacy jax spellings that bypass the ``utils/compat.py`` shims. This
-package codifies them as machine-checked rules.
+no timing spans that measure async dispatch instead of device work, no
+legacy jax spellings that bypass the ``utils/compat.py`` shims, and no
+``PartitionSpec`` literals naming axes outside the mesh catalog. This
+package codifies them as machine-checked rules. (The semantic layer —
+validating a whole launch configuration abstractly — is the
+``analysis.shardcheck`` subpackage, which DOES import jax and therefore
+stays out of this module's imports.)
 
 Entry points:
 

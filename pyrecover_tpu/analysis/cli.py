@@ -23,7 +23,7 @@ def _build_parser():
             "JAX-aware static analysis: host syncs in the hot loop, PRNG "
             "key reuse, donated-buffer reads, traced-value branching, side "
             "effects under jit, non-hashable static args, unsynced timing "
-            "spans, legacy jax spellings."
+            "spans, legacy jax spellings, unknown PartitionSpec axes."
         ),
     )
     p.add_argument(

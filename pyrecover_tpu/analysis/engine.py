@@ -84,6 +84,14 @@ class LintConfig:
     )
     # path suffixes exempt from the legacy-spelling rule (the shim home)
     compat_exempt: tuple = ("utils/compat.py",)
+    # the mesh axis catalog (values of the AXIS_* constants in
+    # parallel/mesh.py — mirrored here because the lint engine must stay
+    # importable without jax; pinned together by tests/test_jaxlint.py).
+    # PartitionSpec literals naming anything else are typos that silently
+    # replicate (JX09).
+    pspec_axes: frozenset = frozenset(
+        {"data", "fsdp", "tensor", "sequence", "pipeline", "expert"}
+    )
 
     def rule_enabled(self, name, rule_id):
         if name in self.ignore or rule_id in self.ignore:

@@ -679,6 +679,61 @@ def check_untimed_device_work(module, ctx):
     return out
 
 
+# ---- JX09: PartitionSpec literals naming unknown mesh axes ------------------
+
+_PSPEC_SOURCES = {"jax.sharding", "jax.interpreters.pxla"}
+
+
+def _pspec_aliases(module, ctx):
+    """Local names bound to PartitionSpec via from-imports (the
+    ``from jax.sharding import PartitionSpec as P`` convention)."""
+    froms = ctx.index.from_imports.get(module, {})
+    return {
+        name for name, (mod, orig) in froms.items()
+        if orig == "PartitionSpec" and mod in _PSPEC_SOURCES
+    }
+
+
+@rule(
+    "JX09", "pspec-unknown-axis", "error",
+    "a PartitionSpec literal names a mesh axis outside the AXIS_* "
+    "catalog — the axis is silently dropped and the dim replicated",
+)
+def check_pspec_axes(module, ctx):
+    known = ctx.config.pspec_axes
+    aliases = _pspec_aliases(module, ctx)
+    out = []
+    r = RULES["pspec-unknown-axis"]
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted_name(node.func)
+        is_pspec = (
+            d is not None
+            and (d == "PartitionSpec" or d.endswith(".PartitionSpec"))
+        ) or (isinstance(node.func, ast.Name) and node.func.id in aliases)
+        if not is_pspec:
+            continue
+        for arg in node.args:
+            elts = (
+                arg.elts if isinstance(arg, (ast.Tuple, ast.List)) else [arg]
+            )
+            for e in elts:
+                if (
+                    isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)
+                    and e.value not in known
+                ):
+                    out.append(finding(
+                        r, module, e,
+                        f"PartitionSpec axis {e.value!r} is not a mesh "
+                        f"axis ({', '.join(sorted(known))}) — "
+                        "_filter_spec_for_mesh drops unknown names and "
+                        "the dimension replicates silently",
+                    ))
+    return out
+
+
 # ---- JX08: legacy jax spellings that bypass utils/compat.py -----------------
 
 _LEGACY_MODULES = {

@@ -1,0 +1,64 @@
+"""shardcheck — abstract SPMD preflight validation.
+
+jaxlint (the sibling ``pyrecover_tpu.analysis`` engine) checks *syntax*;
+shardcheck checks *semantics*: it runs the launch configuration —
+model preset, partition rules, mesh shape, checkpoint schema — entirely
+abstractly (``jax.eval_shape`` / ``jax.make_jaxpr``, virtual meshes of
+any size, no real devices, no HBM) and reports misconfigurations that
+would otherwise only surface minutes into a multi-host TPU job:
+
+* **spec consistency** (``checks.py``) — every partition rule in
+  ``parallel/sharding.py:_RULES`` checked against the abstract parameter
+  pytree: axis divisibility, mesh-axis double-use within one spec,
+  references to axes absent from the resolved mesh, and unintended full
+  replication of leaves above a size threshold.
+* **memory model** (``checks.py``) — per-device HBM estimate (params +
+  AdamW state + dtype-aware activation/logit rough model) against the
+  known device-kind capacities in ``utils/perf.py``.
+* **collective census** (``collectives.py``) — ``jax.make_jaxpr`` over
+  the abstract train step: counts of explicit collectives (ppermute /
+  psum from the pipeline and ring-attention shard_maps) and sharding
+  constraints, plus an analytic model of the GSPMD-inserted per-step
+  collectives (gradient allreduce, ZeRO param allgathers).
+* **checkpoint schema diff** (``manifest.py``) — one manifest schema
+  (pytree paths, shapes, dtypes, pspecs) emitted at save time by BOTH
+  checkpoint engines and statically diffed against the current model at
+  preflight/resume, so an incompatible resume fails in milliseconds
+  instead of mid-restore.
+
+Findings reuse the jaxlint ``Finding`` dataclass and severity
+conventions; check ids are ``SC01..SC10`` (``checks.CHECKS`` is the
+catalog). Entry points: ``tools/shardcheck.py`` (CLI; ``--strict`` is
+the CI gate wired into ``format.sh``) and :func:`runner.check_preset` /
+:func:`runner.preflight` for programmatic use.
+
+This subpackage imports jax (it must trace models); keep it OUT of
+``pyrecover_tpu.analysis.__init__`` so the pure-stdlib lint engine stays
+importable without a backend.
+"""
+
+from pyrecover_tpu.analysis.shardcheck.checks import (
+    CHECKS,
+    ShardcheckConfig,
+    memory_budget,
+    spec_findings,
+)
+from pyrecover_tpu.analysis.shardcheck.manifest import (
+    MANIFEST_SCHEMA_VERSION,
+    diff_manifests,
+    manifest_from_ckpt_meta,
+    read_ckpt_manifest,
+    state_manifest,
+)
+
+__all__ = [
+    "CHECKS",
+    "ShardcheckConfig",
+    "spec_findings",
+    "memory_budget",
+    "MANIFEST_SCHEMA_VERSION",
+    "state_manifest",
+    "manifest_from_ckpt_meta",
+    "read_ckpt_manifest",
+    "diff_manifests",
+]

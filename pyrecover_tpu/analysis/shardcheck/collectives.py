@@ -1,0 +1,176 @@
+"""Collective census: trace the abstract train step, count what moves.
+
+``jax.make_jaxpr`` over the jitted train step (ShapeDtypeStruct args —
+nothing is allocated or compiled) yields every EXPLICIT collective the
+program issues: the pipeline schedule's ``ppermute``/``psum`` inside its
+shard_map, ring attention's ``ppermute``, MoE's all-to-alls. Scan bodies
+are counted once and multiplied by the scan length, so the numbers are
+per-step totals.
+
+GSPMD-inserted collectives (the DP gradient allreduce, ZeRO-3 param
+allgathers, tensor-parallel matmul psums) do not exist at jaxpr level —
+XLA materializes them at partitioning time. Those are covered by the
+ANALYTIC half (:func:`analytic_collectives`): a per-axis byte model
+derived from the partition specs themselves, reported alongside the
+traced counts and labelled as modelled, not observed.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from pyrecover_tpu.analysis.shardcheck.checks import (
+    leaf_nbytes,
+    make_finding,
+    spec_shard_factor,
+)
+
+# jaxpr-level primitives worth reporting (plus anything matching
+# *all_gather*/*psum* that a jax upgrade renames)
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "ppermute", "pbroadcast", "all_gather", "all_to_all",
+    "psum_scatter", "reduce_scatter", "pmax", "pmin",
+})
+STRUCTURE_PRIMS = frozenset({"sharding_constraint", "shard_map", "scan"})
+
+
+def _iter_sub_jaxprs(params):
+    for v in params.values():
+        for cand in v if isinstance(v, (tuple, list)) else (v,):
+            if hasattr(cand, "eqns"):
+                yield cand
+            elif hasattr(getattr(cand, "jaxpr", None), "eqns"):
+                yield cand.jaxpr
+
+
+def count_prims(jaxpr, counts=None, mult=1, gathers=None):
+    """Recursive primitive census. Scan multiplies by its trip count, so
+    a per-layer collective inside the layer scan counts n_layers times.
+    ``gathers`` collects (shape, nbytes) of all_gather outputs for the
+    full-param-gather check."""
+    counts = {} if counts is None else counts
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        counts[name] = counts.get(name, 0) + mult
+        if gathers is not None and name == "all_gather":
+            for var in eqn.outvars:
+                aval = getattr(var, "aval", None)
+                if aval is not None and hasattr(aval, "shape"):
+                    gathers.append(tuple(aval.shape))
+        sub_mult = mult
+        if name == "scan":
+            sub_mult = mult * int(eqn.params.get("length", 1))
+        for sub in _iter_sub_jaxprs(eqn.params):
+            count_prims(sub, counts, sub_mult, gathers)
+    return counts
+
+
+def census(model_config, optimizer, batch_size, seq_len, *, mesh=None,
+           loss_chunk_size=0, config=None, locus="config",
+           param_leaves=None, param_specs=None):
+    """Trace one train step abstractly and return ``(table, findings)``.
+
+    ``mesh``: a concrete Mesh to trace under (activates the sharding
+    constraints and the pipeline/ring shard_map paths); None traces
+    mesh-free (constraints no-op — counts still cover the collective-free
+    structure). ``param_leaves``/``param_specs`` (the spec-check inputs)
+    feed the full-param-gather scan and the analytic model.
+    """
+    from pyrecover_tpu.analysis.shardcheck.checks import DEFAULT_CONFIG
+    from pyrecover_tpu.config import TrainConfig
+    from pyrecover_tpu.train_state import create_train_state, make_train_step
+
+    config = config or DEFAULT_CONFIG
+    if optimizer is None:
+        from pyrecover_tpu.optim import build_optimizer
+
+        optimizer, _ = build_optimizer(TrainConfig())
+    abstract = jax.eval_shape(
+        lambda key: create_train_state(key, model_config, optimizer),
+        jax.random.key(0),
+    )
+    step_fn = make_train_step(
+        model_config, optimizer, donate=False,
+        loss_chunk_size=loss_chunk_size,
+    )
+    batch = {
+        "inputs": jax.ShapeDtypeStruct((batch_size, seq_len), jnp.int32),
+        "labels": jax.ShapeDtypeStruct((batch_size, seq_len), jnp.int32),
+    }
+    counts, gathers = {}, []
+    try:
+        if mesh is not None:
+            with jax.sharding.set_mesh(mesh):
+                jaxpr = jax.make_jaxpr(step_fn)(abstract, batch)
+        else:
+            jaxpr = jax.make_jaxpr(step_fn)(abstract, batch)
+    except Exception as e:
+        # the step does not even TRACE with this config (batch vs
+        # microbatch divisibility, schedule constraints, ...): that is a
+        # launch failure caught at preflight — report it, don't crash
+        return (
+            {"error": f"{type(e).__name__}: {e}",
+             "mesh_context": mesh is not None},
+            [make_finding(
+                "SC01", locus,
+                f"train step fails to trace abstractly with batch="
+                f"{batch_size}, seq={seq_len}: {e}",
+            )],
+        )
+    count_prims(jaxpr.jaxpr, counts, 1, gathers)
+
+    table = {
+        "traced": {
+            k: counts[k] for k in sorted(counts)
+            if k in COLLECTIVE_PRIMS or k in STRUCTURE_PRIMS
+            or "all_gather" in k or "psum" in k
+        },
+        "mesh_context": mesh is not None,
+    }
+    findings = []
+    if param_leaves is not None:
+        big = {
+            tuple(shape): path for path, shape, dtype in param_leaves
+            if leaf_nbytes(shape, dtype) >= config.replicated_threshold_bytes
+        }
+        for shape in gathers:
+            if shape in big and config.check_enabled("SC06"):
+                findings.append(make_finding(
+                    "SC06", locus,
+                    f"traced step all-gathers a full copy of "
+                    f"{big[shape]} {shape} — a spec is forcing whole-"
+                    "parameter materialization",
+                ))
+                big.pop(shape)  # one finding per leaf
+    return table, findings
+
+
+def analytic_collectives(param_leaves, param_specs, mesh_shape):
+    """Modelled per-step GSPMD collectives, derived from the specs.
+
+    * ``data`` > 1 — one gradient allreduce of every param's bytes.
+    * ``fsdp`` > 1 — ZeRO-3: each fsdp-sharded param is allgathered for
+      forward and backward (2×) and its gradient reduce-scattered (1×).
+    * ``tensor``/``expert`` — bytes of the leaves each axis shards (the
+      per-matmul psums ride activations, not params; reported as the
+      sharded footprint driving them).
+
+    All numbers are bytes per optimizer step, modelled — the census
+    header marks them as such.
+    """
+    total = sum(leaf_nbytes(s, d) for _, s, d in param_leaves)
+    per_axis = {}
+    for (path, shape, dtype), spec in zip(param_leaves, param_specs):
+        nbytes = leaf_nbytes(shape, dtype)
+        for axis, size in mesh_shape.items():
+            if size > 1 and spec_shard_factor(spec, {axis: size}) > 1:
+                per_axis.setdefault(axis, 0)
+                per_axis[axis] += nbytes
+    out = {"modelled": True, "param_bytes_total": total}
+    if mesh_shape.get("data", 1) > 1:
+        out["dp_grad_allreduce_bytes"] = total
+    if mesh_shape.get("fsdp", 1) > 1:
+        fsdp_bytes = per_axis.get("fsdp", 0)
+        out["fsdp_param_allgather_bytes"] = 2 * fsdp_bytes
+        out["fsdp_grad_reduce_scatter_bytes"] = fsdp_bytes
+    out["sharded_param_bytes_by_axis"] = per_axis
+    return out

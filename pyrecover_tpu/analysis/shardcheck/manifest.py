@@ -1,0 +1,191 @@
+"""Checkpoint schema manifest: one schema, every producer/consumer.
+
+A manifest is a JSON-serializable record of a training state's SCHEMA —
+pytree leaf paths, global shapes, dtypes, and partition specs — without
+any tensor data. Both checkpoint engines embed one at save time
+(``checkpoint/vanilla.py`` in the file's meta header, ``checkpoint/
+sharded.py`` in the Orbax ``meta`` item), ``tools/inspect_checkpoint.py
+--manifest`` prints it, and :func:`diff_manifests` statically compares a
+saved manifest against the current model/config so an incompatible
+resume fails in milliseconds (a header read) instead of mid-restore.
+
+Shape::
+
+    {"schema": 1, "num_leaves": N,
+     "leaves": [{"path": ".params['tok_embed']",
+                 "shape": [131072, 4096], "dtype": "float32",
+                 "spec": [null, ["tensor", "fsdp"]]}, ...]}
+
+``spec`` entries mirror PartitionSpec entries: ``null`` (replicated
+dim), an axis name, or a list of axis names; ``spec: null`` means the
+sharding was unknown at save time (host-local arrays, legacy files).
+"""
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+from pyrecover_tpu.analysis.shardcheck.checks import make_finding
+
+MANIFEST_SCHEMA_VERSION = 1
+
+
+def spec_to_json(spec):
+    """PartitionSpec -> JSON entries (None | str | list[str]), or None."""
+    if spec is None:
+        return None
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            out.append([str(a) for a in entry])
+        else:
+            out.append(str(entry))
+    return out
+
+
+def _leaf_spec(leaf):
+    """Partition spec carried by a live jax.Array (NamedSharding), else
+    None (host arrays, single-device shardings, abstract leaves)."""
+    sharding = getattr(leaf, "sharding", None)
+    return spec_to_json(getattr(sharding, "spec", None))
+
+
+def state_manifest(state, specs=None):  # jaxlint: host-only
+    """Build the manifest for a (live or abstract) state pytree.
+    Reads only leaf METADATA (.shape/.dtype/.sharding) — no device
+    values, no syncs; reached from the hot loop via both engines' save.
+
+    ``specs``: optional aligned PartitionSpec pytree — used for abstract
+    states (eval_shape output carries no shardings). Live sharded states
+    need nothing: each leaf's NamedSharding supplies its spec.
+    """
+    import jax
+    from jax.sharding import PartitionSpec
+
+    path_leaves, _ = jax.tree_util.tree_flatten_with_path(state)
+    spec_list = (
+        [None] * len(path_leaves) if specs is None
+        else jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda x: x is None or isinstance(x, PartitionSpec)
+        )
+    )
+    leaves = []
+    for (path, leaf), spec in zip(path_leaves, spec_list):
+        leaves.append({
+            "path": jax.tree_util.keystr(path),
+            "shape": [int(s) for s in leaf.shape],
+            "dtype": str(np.dtype(leaf.dtype)),
+            "spec": spec_to_json(spec) if spec is not None else _leaf_spec(leaf),
+        })
+    return {
+        "schema": MANIFEST_SCHEMA_VERSION,
+        "num_leaves": len(leaves),
+        "leaves": leaves,
+    }
+
+
+def manifest_from_ckpt_meta(meta):
+    """Manifest from a vanilla checkpoint's meta header.
+
+    v0.5+ files embed it verbatim (``meta["manifest"]``); older files
+    carry paths + per-leaf dtype/shape, from which a spec-less manifest
+    is synthesized — the diff then checks structure but not layout.
+    """
+    if "manifest" in meta:
+        return meta["manifest"]
+    paths = meta.get("paths") or [
+        f"leaf{i}" for i in range(meta.get("num_leaves", 0))
+    ]
+    leaves = [
+        {"path": p, "shape": list(lm["shape"]), "dtype": lm["dtype"],
+         "spec": None}
+        for p, lm in zip(paths, meta.get("leaves", []))
+    ]
+    return {"schema": 0, "num_leaves": len(leaves), "leaves": leaves}
+
+
+def read_ckpt_manifest(path):
+    """Read the manifest of a checkpoint at ``path`` (either engine).
+
+    Vanilla single-file: a header-only read (O(meta) bytes). Sharded
+    directory: the ``meta`` JSON item; when it predates manifests, one is
+    synthesized (spec-less) from the Orbax pytree metadata probe.
+    """
+    path = Path(path)
+    if path.is_dir():
+        meta_file = path / "meta" / "metadata"
+        if meta_file.exists():
+            meta = json.loads(meta_file.read_text())
+            if "manifest" in meta:
+                return meta["manifest"]
+        import jax
+        import orbax.checkpoint as ocp
+
+        tree = ocp.PyTreeCheckpointHandler().metadata(path / "state").tree
+        flat = jax.tree_util.tree_flatten_with_path(
+            tree,
+            is_leaf=lambda x: hasattr(x, "shape") and hasattr(x, "dtype"),
+        )[0]
+        leaves = [
+            {"path": jax.tree_util.keystr(p),
+             "shape": [int(s) for s in (getattr(x, "shape", ()) or ())],
+             "dtype": str(np.dtype(x.dtype)), "spec": None}
+            for p, x in flat
+        ]
+        return {"schema": 0, "num_leaves": len(leaves), "leaves": leaves}
+    from pyrecover_tpu.checkpoint.vanilla import read_ckpt_meta
+
+    return manifest_from_ckpt_meta(read_ckpt_meta(path, check_version=False))
+
+
+def diff_manifests(saved, current, locus="checkpoint", *, check_specs=True):
+    """Statically diff a saved manifest against the current model's.
+
+    Returns Findings: SC07 (leaf set mismatch), SC08 (shape drift), SC09
+    (dtype drift), SC10 (pspec drift — a warning: restore reshards
+    freely, but the layout intent changed). An empty list means the
+    checkpoint structurally fits the configured model.
+    """
+    out = []
+    saved_map = {e["path"]: e for e in saved.get("leaves", [])}
+    cur_map = {e["path"]: e for e in current.get("leaves", [])}
+    only_saved = [p for p in saved_map if p not in cur_map]
+    only_cur = [p for p in cur_map if p not in saved_map]
+    if only_saved or only_cur:
+        out.append(make_finding(
+            "SC07", locus,
+            f"leaf sets differ: {len(only_saved)} only in checkpoint "
+            f"(e.g. {only_saved[:3]}), {len(only_cur)} only in model "
+            f"(e.g. {only_cur[:3]}) — wrong model config, not corruption",
+        ))
+    for path, s in saved_map.items():
+        c = cur_map.get(path)
+        if c is None:
+            continue
+        if list(s["shape"]) != list(c["shape"]):
+            out.append(make_finding(
+                "SC08", locus,
+                f"{path}: shape {tuple(s['shape'])} in checkpoint vs "
+                f"{tuple(c['shape'])} in model",
+            ))
+        elif s["dtype"] != c["dtype"]:
+            out.append(make_finding(
+                "SC09", locus,
+                f"{path}: dtype {s['dtype']} in checkpoint vs {c['dtype']} "
+                "in model — restore would silently cast",
+            ))
+        elif (
+            check_specs
+            and s.get("spec") is not None
+            and c.get("spec") is not None
+            and s["spec"] != c["spec"]
+        ):
+            out.append(make_finding(
+                "SC10", locus,
+                f"{path}: partition spec {s['spec']} in checkpoint vs "
+                f"{c['spec']} in model",
+            ))
+    return out

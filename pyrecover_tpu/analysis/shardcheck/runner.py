@@ -1,0 +1,218 @@
+"""Preflight orchestration: presets × virtual meshes → findings + report.
+
+``preflight`` validates one (model config, mesh config, device count)
+triple; ``check_preset`` sweeps a preset over the standard virtual-mesh
+matrix at 1/2/4/8 devices (the CI gate), attaches the memory budget and
+the collective census, and returns a JSON-ready report dict. Everything
+is abstract — ``n_devices`` is a number, not hardware — except the
+census, which additionally traces under a CONCRETE mesh when the process
+has enough (virtual CPU) devices, because the sharding-constraint /
+shard_map code paths only activate inside a real mesh context.
+"""
+
+import jax
+
+from pyrecover_tpu.analysis.shardcheck.checks import (
+    DEFAULT_CONFIG,
+    make_finding,
+    memory_budget,
+    spec_findings,
+)
+from pyrecover_tpu.analysis.shardcheck.collectives import (
+    analytic_collectives,
+    census,
+)
+from pyrecover_tpu.parallel.mesh import MESH_AXES, MeshConfig
+
+BATCH_LEAF = "<batch tokens>"
+
+
+def abstract_state_leaves(model_config, optimizer=None):
+    """``(leaves, specs)`` for the FULL train state, abstractly.
+
+    ``leaves`` are ``(keystr path, shape, dtype)`` triples from
+    ``jax.eval_shape`` over ``create_train_state`` (params + AdamW
+    moments + counters — the optimizer moments mirror the param leaf
+    names, so the same path rules shard them); ``specs`` is the aligned
+    PartitionSpec list from ``train.state_pspecs``.
+    """
+    from pyrecover_tpu.config import TrainConfig
+    from pyrecover_tpu.optim import build_optimizer
+    from pyrecover_tpu.train import state_pspecs
+    from pyrecover_tpu.train_state import create_train_state
+
+    if optimizer is None:
+        optimizer, _ = build_optimizer(TrainConfig())
+    abstract = jax.eval_shape(
+        lambda key: create_train_state(key, model_config, optimizer),
+        jax.random.key(0),
+    )
+    specs = state_pspecs(abstract)
+    path_leaves = jax.tree_util.tree_flatten_with_path(abstract)[0]
+    leaves = [
+        (jax.tree_util.keystr(p), tuple(x.shape), x.dtype)
+        for p, x in path_leaves
+    ]
+    from jax.sharding import PartitionSpec
+
+    spec_list = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
+    )
+    return leaves, spec_list
+
+
+def mesh_matrix(model_config, n_devices):
+    """The launchable mesh shapes the gate checks at ``n_devices``: pure
+    DP, then each parallelism axis alone, then (at >=4 devices) the
+    fsdp×tensor composite. Axes with model-side divisibility PREREQS
+    (pipeline needs layers % stages, expert needs experts % size,
+    sequence needs seq % size) are included only when the preset can
+    launch them at all — an explicit CLI mesh still checks anything."""
+    cfg, n = model_config, n_devices
+    out = [MeshConfig(data=n)]
+    if n == 1:
+        return out
+    out.append(MeshConfig(data=1, fsdp=n))
+    out.append(MeshConfig(data=1, tensor=n))
+    if cfg.max_seq_len % n == 0:
+        out.append(MeshConfig(data=1, sequence=n))
+    if cfg.n_layers % n == 0:
+        out.append(MeshConfig(data=1, pipeline=n))
+    if cfg.n_experts > 0 and cfg.n_experts % n == 0:
+        out.append(MeshConfig(data=1, expert=n))
+    if n % 4 == 0:
+        out.append(MeshConfig(data=n // 4, fsdp=2, tensor=2))
+    return out
+
+
+def resolve_mesh_shape(mesh_config, n_devices):
+    """dict axis -> size for a virtual mesh (no devices involved)."""
+    return dict(zip(MESH_AXES, mesh_config.resolve(n_devices)))
+
+
+def mesh_desc(mesh_shape):
+    nontrivial = [f"{k}{v}" for k, v in mesh_shape.items() if v > 1]
+    return "×".join(nontrivial) if nontrivial else "single"
+
+
+def preflight(model_config, mesh_config, n_devices, *, config=None,
+              locus=None, batch_size=None, seq_len=None, leaves=None,
+              specs=None):
+    """Spec-consistency preflight of one launch triple. Returns
+    ``(findings, mesh_shape)``; ``mesh_shape`` is None when the mesh
+    itself cannot resolve (that is itself a finding)."""
+    config = config or DEFAULT_CONFIG
+    locus = locus or "config"
+    try:
+        mesh_shape = resolve_mesh_shape(mesh_config, n_devices)
+    except ValueError as e:
+        return [make_finding("SC01", locus, str(e))], None
+    if leaves is None:
+        leaves, specs = abstract_state_leaves(model_config)
+    seq = seq_len or model_config.max_seq_len
+    batch = batch_size or (
+        mesh_shape.get("data", 1) * mesh_shape.get("fsdp", 1)
+    )
+    from pyrecover_tpu.parallel.sharding import batch_pspec
+
+    leaves = list(leaves) + [(BATCH_LEAF, (batch, seq), jax.numpy.int32)]
+    specs = list(specs) + [batch_pspec()]
+    findings = spec_findings(
+        leaves, specs, mesh_shape,
+        config=config, locus=f"{locus}@{mesh_desc(mesh_shape)}",
+    )
+    return findings, mesh_shape
+
+
+def _param_only(leaves, specs):
+    pl, ps = [], []
+    for leaf, spec in zip(leaves, specs):
+        if leaf[0].startswith(".params"):
+            pl.append(leaf)
+            ps.append(spec)
+    return pl, ps
+
+
+def check_preset(name, model_config, *, device_counts=(1, 2, 4, 8),
+                 config=None, batch_size=None, seq_len=None,
+                 run_census=True, mesh_configs=None):
+    """Full preflight of one preset: spec matrix + memory + census.
+
+    Returns a report dict (JSON-ready except the Finding objects under
+    ``"findings"`` — the CLI serializes those).
+    """
+    config = config or DEFAULT_CONFIG
+    leaves, specs = abstract_state_leaves(model_config)
+    report = {
+        "preset": name,
+        "findings": [],
+        "meshes": [],
+        "memory": None,
+        "census": None,
+    }
+    rep_shape = None  # representative mesh for memory/census: last clean one
+    rep_config = None
+    for n in device_counts:
+        matrix = (
+            mesh_configs if mesh_configs is not None
+            else mesh_matrix(model_config, n)
+        )
+        for mesh_cfg in matrix:
+            findings, mesh_shape = preflight(
+                model_config, mesh_cfg, n, config=config, locus=name,
+                batch_size=batch_size, seq_len=seq_len,
+                leaves=leaves, specs=specs,
+            )
+            report["findings"].extend(findings)
+            report["meshes"].append({
+                "devices": n,
+                "mesh": mesh_desc(mesh_shape) if mesh_shape else "unresolvable",
+                "findings": len(findings),
+            })
+            if mesh_shape is not None and not findings:
+                rep_shape, rep_config = mesh_shape, mesh_cfg
+    if rep_shape is None:
+        rep_shape = resolve_mesh_shape(MeshConfig(data=1), 1)
+        rep_config = MeshConfig(data=1)
+
+    seq = seq_len or model_config.max_seq_len
+    batch = batch_size or (
+        rep_shape.get("data", 1) * rep_shape.get("fsdp", 1)
+        * rep_shape.get("pipeline", 1)
+    )
+    mem_rows, mem_findings = memory_budget(
+        leaves, specs, rep_shape, model_config,
+        batch_size=batch, seq_len=seq, config=config,
+        locus=f"{name}@{mesh_desc(rep_shape)}",
+    )
+    mem_rows["mesh"] = mesh_desc(rep_shape)
+    mem_rows["batch_size"] = batch
+    mem_rows["seq_len"] = seq
+    report["memory"] = mem_rows
+    report["findings"].extend(mem_findings)
+
+    if run_census:
+        param_leaves, param_specs = _param_only(leaves, specs)
+        n_dev = 1
+        for v in rep_shape.values():
+            n_dev *= v
+        mesh = None
+        try:
+            if len(jax.devices()) >= n_dev:
+                from pyrecover_tpu.parallel.mesh import create_mesh
+
+                mesh = create_mesh(rep_config, devices=jax.devices()[:n_dev])
+        except Exception:
+            mesh = None  # no backend / too few devices: trace mesh-free
+        table, census_findings = census(
+            model_config, None, batch, seq, mesh=mesh, config=config,
+            locus=f"{name}@{mesh_desc(rep_shape)}",
+            param_leaves=param_leaves, param_specs=param_specs,
+        )
+        table["mesh"] = mesh_desc(rep_shape)
+        table["analytic"] = analytic_collectives(
+            param_leaves, param_specs, rep_shape
+        )
+        report["census"] = table
+        report["findings"].extend(census_findings)
+    return report

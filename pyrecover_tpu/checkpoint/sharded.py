@@ -50,7 +50,14 @@ class ShardedCheckpointer:
             "ckpt_save_start", engine="sharded", path=str(path),
             async_=self.use_async,
         )
-        meta = {"sampler": sampler_state or {}}
+        # same schema manifest the vanilla engine embeds (one schema,
+        # two producers): preflight/resume diff it without tensor reads
+        from pyrecover_tpu.analysis.shardcheck.manifest import state_manifest
+
+        meta = {
+            "sampler": sampler_state or {},
+            "manifest": state_manifest(state),
+        }
         if extra_meta:
             meta.update(extra_meta)
         self._ckptr.save(
@@ -158,7 +165,7 @@ def precheck_ckpt_sharded(path, target_state=None):
         meta_file = path / "meta" / "metadata"
         if not meta_file.exists():
             return False, "missing meta item"
-        json.loads(meta_file.read_text())
+        meta = json.loads(meta_file.read_text())
         state_dir = path / "state"
         manifest = state_dir / "manifest.ocdbt"
         if not manifest.exists() or manifest.stat().st_size == 0:
@@ -167,8 +174,9 @@ def precheck_ckpt_sharded(path, target_state=None):
         if not tree_meta.exists():
             return False, "missing pytree _METADATA"
         # the metadata probe below parses _METADATA itself; malformed JSON
-        # surfaces there
-        md = ocp.PyTreeCheckpointHandler().metadata(state_dir).tree
+        # surfaces there (.tree on newer orbax, the raw dict on older)
+        md = ocp.PyTreeCheckpointHandler().metadata(state_dir)
+        md = md.tree if hasattr(md, "tree") else md
         ck_shapes = sorted(
             tuple(x.shape)
             for x in jax.tree_util.tree_leaves(
@@ -178,6 +186,39 @@ def precheck_ckpt_sharded(path, target_state=None):
     except Exception as e:
         return False, f"{type(e).__name__}: {e}"
     if target_state is not None:
+        # schema manifest (saved by this engine since v0.5): exact per-
+        # path diff with real leaf names — and dtype-drift visibility the
+        # shape multiset below cannot give
+        if isinstance(meta, dict) and "manifest" in meta:
+            from pyrecover_tpu.analysis.shardcheck.manifest import (
+                diff_manifests,
+                state_manifest,
+            )
+
+            findings = diff_manifests(
+                meta["manifest"], state_manifest(target_state),
+                locus=path.name, check_specs=False,
+            )
+            structural = [
+                f for f in findings if f.rule_id in ("SC07", "SC08")
+            ]
+            if structural:
+                raise CheckpointStructureError(
+                    f"checkpoint {path.name} does not fit the configured "
+                    "model: "
+                    + "; ".join(f.message for f in structural[:3])
+                )
+            for f in findings:
+                if f.rule_id == "SC09":
+                    log_host0(
+                        "resume manifest: %s (restore will cast)",
+                        f.message, level=30,  # WARNING
+                    )
+                    telemetry.emit(
+                        "ckpt_manifest_dtype_drift", path=str(path),
+                        detail=f.message,
+                    )
+            return True, ""
         tgt_shapes = sorted(
             tuple(x.shape) for x in jax.tree_util.tree_leaves(target_state)
         )

@@ -218,6 +218,12 @@ def save_ckpt_vanilla(path, state, sampler_state=None, *, verify=False,
     )
     sync_global_devices("vanilla_save_enter")
 
+    # schema manifest (paths/shapes/dtypes/pspecs): the single cross-
+    # engine schema record — shardcheck diffs it at preflight/resume and
+    # tools/inspect_checkpoint.py --manifest prints it
+    from pyrecover_tpu.analysis.shardcheck.manifest import state_manifest
+
+    manifest = state_manifest(state)
     path_leaves, treedef = jax.tree_util.tree_flatten_with_path(state)
     keystrs = [jax.tree_util.keystr(p) for p, _ in path_leaves]
     meta = {
@@ -232,6 +238,7 @@ def save_ckpt_vanilla(path, state, sampler_state=None, *, verify=False,
             {"dtype": str(np.dtype(x.dtype)), "shape": list(x.shape)}
             for _, x in path_leaves
         ],
+        "manifest": manifest,
     }
     if extra_meta:
         meta.update(extra_meta)
@@ -457,6 +464,22 @@ def _decode_ckpt_bytes(data, *, check_version=True):
     return meta, paths, leaves
 
 
+def read_ckpt_meta(path, *, check_version=True):
+    """Header-only read of a vanilla checkpoint's meta JSON: MAGIC + one
+    length prefix + the meta blob — O(meta) bytes, no tensor data. The
+    millisecond path behind manifest diffs at resume. Legacy v1 files
+    have no framed header, so they fall back to a full decode."""
+    path = Path(path)
+    with open(path, "rb") as f:
+        if f.read(len(MAGIC)) != MAGIC:
+            return read_ckpt_raw(path, check_version=check_version)[0]
+        mlen = int.from_bytes(f.read(8), "little")
+        meta = json.loads(f.read(mlen).decode())
+    if check_version and meta["format"] not in SUPPORTED_FORMATS:
+        raise ValueError(f"Unsupported checkpoint format {meta['format']}")
+    return meta
+
+
 def _walk_ckpt_frames(path):
     """Seek-based structural walk of a v2 container: reads only the magic,
     the meta header, and each leaf's 8-byte length prefix — O(meta) bytes
@@ -485,7 +508,7 @@ def _walk_ckpt_frames(path):
             f.seek(off)
 
 
-def precheck_ckpt_vanilla(path, *, verify=False):
+def precheck_ckpt_vanilla(path, *, verify=False, target_state=None):
     """Host-LOCAL integrity check (no collectives): the sidecar checksum is
     verified with a CHUNKED streaming read (O(chunk) host RAM — at the 8B
     flagship a whole-file buffer here would undo the streaming-save RAM
@@ -493,7 +516,14 @@ def precheck_ckpt_vanilla(path, *, verify=False):
     walked with header-only seeks. Returns (ok, reason). Used by the
     latest-resume fallback to agree on a candidate on host 0 BEFORE every
     host enters the collective load (a per-host exception inside the load
-    would desynchronize the barrier protocol on pods)."""
+    would desynchronize the barrier protocol on pods).
+
+    When ``target_state`` is given, the checkpoint's schema manifest
+    (header read, milliseconds) is statically diffed against it: a leaf-
+    set or shape drift raises ``CheckpointStructureError`` — the same
+    wrong-model-config protocol as the sharded precheck — so an
+    incompatible resume dies here instead of mid-restore; a dtype drift
+    is warned about (the restore path casts deliberately)."""
     path = Path(path)
     try:
         sidecar = _sidecar(path)
@@ -506,6 +536,37 @@ def precheck_ckpt_vanilla(path, *, verify=False):
         _walk_ckpt_frames(path)
     except Exception as e:
         return False, f"{type(e).__name__}: {e}"
+    if target_state is not None:
+        from pyrecover_tpu.analysis.shardcheck.manifest import (
+            diff_manifests,
+            manifest_from_ckpt_meta,
+            state_manifest,
+        )
+
+        saved = manifest_from_ckpt_meta(
+            read_ckpt_meta(path, check_version=False)
+        )
+        findings = diff_manifests(
+            saved, state_manifest(target_state), locus=path.name,
+            check_specs=False,
+        )
+        structural = [f for f in findings if f.rule_id in ("SC07", "SC08")]
+        if structural:
+            raise CheckpointStructureError(
+                f"checkpoint {path.name} does not fit the configured "
+                "model: "
+                + "; ".join(f.message for f in structural[:3])
+            )
+        for f in findings:
+            if f.rule_id == "SC09":
+                log_host0(
+                    "resume manifest: %s (restore will cast)", f.message,
+                    level=30,  # WARNING
+                )
+                telemetry.emit(
+                    "ckpt_manifest_dtype_drift", path=str(path),
+                    detail=f.message,
+                )
     return True, ""
 
 

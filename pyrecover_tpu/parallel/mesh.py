@@ -155,19 +155,59 @@ def mesh_axis_size(mesh, axis):
     return mesh.shape.get(axis, 1)
 
 
-def _filter_spec_for_mesh(spec, axis_names):
+_dropped_axes_warned = set()
+
+
+def _note_dropped_axis(axis, axis_names):
+    """A spec named an axis the mesh does not have AT ALL (not a manual
+    axis being filtered — those are deliberate): the dimension will be
+    silently replicated, which is exactly how a typo'd or stale axis name
+    turns into a 6× memory regression. Warn + emit telemetry once per
+    axis name per process so the regression is visible without spamming
+    every trace."""
+    if axis in _dropped_axes_warned:
+        return
+    _dropped_axes_warned.add(axis)
+    from pyrecover_tpu import telemetry
+    from pyrecover_tpu.utils.logging import log_host0
+
+    log_host0(
+        "sharding spec names axis %r which is absent from the mesh axes "
+        "%s; the axis is DROPPED and that dimension replicated — if this "
+        "is not a deliberately partial mesh, fix the spec (shardcheck "
+        "flags this as SC02)", axis, tuple(axis_names),
+        level=30,  # WARNING
+    )
+    telemetry.emit(
+        "spec_axis_dropped", axis=str(axis), mesh_axes=list(axis_names)
+    )
+
+
+def _filter_spec_for_mesh(spec, axis_names, all_axis_names=None):
     """Drop mesh axes that don't exist (size-1 axes are fine; missing names
     would error), so model code can annotate with the full logical spec and
-    degrade gracefully on smaller meshes."""
+    degrade gracefully on smaller meshes. ``all_axis_names``, when given,
+    is the mesh's FULL axis set: an axis absent from it (as opposed to
+    one filtered because it is manually bound by an enclosing shard_map)
+    is warned about once per process — silent drops are how replication
+    regressions hide."""
     out = []
+
+    def keep(a):
+        if a in axis_names:
+            return True
+        if all_axis_names is not None and a not in all_axis_names:
+            _note_dropped_axis(a, all_axis_names)
+        return False
+
     for entry in spec:
         if entry is None:
             out.append(None)
         elif isinstance(entry, (tuple, list)):
-            kept = tuple(a for a in entry if a in axis_names)
+            kept = tuple(a for a in entry if keep(a))
             out.append(kept if kept else None)
         else:
-            out.append(entry if entry in axis_names else None)
+            out.append(entry if keep(entry) else None)
     return P(*out)
 
 
@@ -225,7 +265,9 @@ def constrain(x, *spec):
     mesh = jax.sharding.get_abstract_mesh()
     if mesh is None or mesh.empty:
         return x
-    filtered = _filter_spec_for_mesh(spec, nonmanual_axes(mesh))
+    filtered = _filter_spec_for_mesh(
+        spec, nonmanual_axes(mesh), all_axis_names=set(mesh.axis_names)
+    )
     return jax.lax.with_sharding_constraint(x, filtered)
 
 

@@ -30,6 +30,8 @@ Core event names across the stack (fields beyond the envelope):
     maintenance_event / maintenance_watcher_retired / maintenance_degraded
     data_stall        wait_s, depth, batch
     mfu_peak_unknown  device_kind, fallback_flops
+    spec_axis_dropped axis, mesh_axes (a sharding spec named a missing axis)
+    ckpt_manifest_dtype_drift  path, detail (resume will cast the leaf)
     run_summary       status, step, + WallTimeTotals.as_dict() (goodput)
 
 ``tools/summarize_telemetry.py`` turns a run's JSONL into a goodput

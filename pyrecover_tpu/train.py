@@ -270,8 +270,12 @@ def _resume(config, exp_dir, state, sampler, sharded_ckptr, totals):  # jaxlint:
                     if config.sharded_checkpoint:
                         ok, reason = precheck_ckpt_sharded(cand, state)
                     else:
+                        # target_state activates the manifest schema diff:
+                        # a wrong-model resume dies on a header read here,
+                        # not minutes later mid-restore
                         ok, reason = precheck_ckpt_vanilla(
-                            cand, verify=config.verify_checkpoints
+                            cand, verify=config.verify_checkpoints,
+                            target_state=state,
                         )
                     verdict = 1 if ok else 0
                 except CheckpointStructureError as e:

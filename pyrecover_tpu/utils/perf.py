@@ -23,6 +23,19 @@ TPU_PEAK_FLOPS_BF16 = {
     "v6e": 918e12,
 }
 
+# HBM per JAX device, bytes, per TPU generation (same public docs; v3
+# counts per core — a JAX device is one core there). Consumed by the
+# shardcheck memory budget (analysis/shardcheck).
+TPU_HBM_BYTES = {
+    "v3": 16 * 2**30,
+    "v4": 32 * 2**30,
+    "v5e": 16 * 2**30,
+    "v5litepod": 16 * 2**30,
+    "v5 lite": 16 * 2**30,
+    "v5p": 95 * 2**30,
+    "v6e": 32 * 2**30,
+}
+
 _CPU_FALLBACK_PEAK = 1e12  # arbitrary stand-in so MFU math never divides by 0
 
 _warned_unknown_kinds = set()
@@ -57,6 +70,22 @@ def tpu_peak_flops(device=None):
             fallback_flops=_CPU_FALLBACK_PEAK,
         )
     return _CPU_FALLBACK_PEAK
+
+
+def tpu_hbm_bytes(device_kind=None, device=None):
+    """HBM bytes for a device kind (or the local accelerator), or None
+    when unknown. Unlike :func:`tpu_peak_flops` this does NOT fall back
+    to a stand-in: callers (the shardcheck budget) treat None as
+    "capacity unknown, report without judging"."""
+    if device_kind is None:
+        if device is None:
+            device = jax.devices()[0]
+        device_kind = getattr(device, "device_kind", "")
+    kind = device_kind.lower()
+    for key, cap in TPU_HBM_BYTES.items():
+        if key in kind:
+            return cap
+    return None
 
 
 def get_num_params(params, exclude_embedding=False):

@@ -186,6 +186,20 @@ def wrap(f, mesh, specs):
     return jax.shard_map(f, mesh=mesh, in_specs=specs, out_specs=specs)
 """,
     ),
+    "pspec-unknown-axis": (
+        """
+from jax.sharding import PartitionSpec as P
+
+def spec():
+    return P("data", "tensr")
+""",
+        """
+from jax.sharding import PartitionSpec as P
+
+def spec():
+    return P("data", ("fsdp", "tensor"), None)
+""",
+    ),
 }
 
 
@@ -236,6 +250,30 @@ def test_every_catalog_rule_has_a_fixture():
     assert set(RULE_FIXTURES) == set(RULES), (
         "each rule ships with a true-positive + clean fixture pair"
     )
+
+
+def test_pspec_axis_catalog_matches_mesh_constants():
+    """JX09's axis catalog is a stdlib-side mirror of the AXIS_* constants
+    (the lint engine must import without jax) — pin them together."""
+    from pyrecover_tpu.analysis.engine import DEFAULT_CONFIG
+    from pyrecover_tpu.parallel import mesh
+
+    assert DEFAULT_CONFIG.pspec_axes == set(mesh.MESH_AXES)
+
+
+def test_pspec_rule_ignores_nonliteral_axes():
+    """Axis names flowing through variables/constants (the package's own
+    style) are out of JX09's scope — no false positives on them."""
+    src = """
+from jax.sharding import PartitionSpec as P
+
+AXIS = "whatever_runtime_name"
+
+def spec(axis):
+    return P(AXIS, axis, None)
+"""
+    result = lint_source(src)
+    assert "pspec-unknown-axis" not in names(result)
 
 
 # ---------------------------------------------------------------------------

@@ -1,11 +1,18 @@
 #!/usr/bin/env python
 """Inspect a checkpoint (either format): step/epoch metadata, sampler
-data-order state, leaf count/shapes/dtypes/bytes.
+data-order state, leaf count/shapes/dtypes/pspecs/bytes.
 
-Usage: python tools/inspect_checkpoint.py PATH [--leaves]
+Usage: python tools/inspect_checkpoint.py PATH [--leaves] [--manifest]
+
+``--manifest`` prints the checkpoint's schema manifest as JSON — the
+exact document ``pyrecover_tpu.analysis.shardcheck`` diffs at preflight/
+resume (``shardcheck --diff-checkpoint``), read from the meta header
+alone (no tensor data). The human ``--leaves`` listing renders the same
+manifest, so the two surfaces cannot drift.
 """
 
 import argparse
+import json
 import sys
 from pathlib import Path
 
@@ -20,11 +27,39 @@ def human(n):
     return f"{n:.1f}PB"
 
 
+def _manifest_nbytes(entry):
+    import numpy as np
+
+    from pyrecover_tpu.checkpoint.vanilla import _dtype_from_str
+
+    n = _dtype_from_str(entry["dtype"]).itemsize
+    for s in entry["shape"]:
+        n *= s
+    return n
+
+
+def _print_manifest_rows(manifest, show_leaves):
+    total = sum(_manifest_nbytes(e) for e in manifest["leaves"])
+    print(f"leaves: {manifest['num_leaves']} | total {human(total)}")
+    if show_leaves:
+        for e in manifest["leaves"]:
+            spec = f" @ {e['spec']}" if e.get("spec") is not None else ""
+            print(
+                f"  {e['path']}: {e['dtype']} {tuple(e['shape'])} "
+                f"{human(_manifest_nbytes(e))}{spec}"
+            )
+
+
 def inspect_vanilla(path, show_leaves):
+    from pyrecover_tpu.analysis.shardcheck.manifest import (
+        manifest_from_ckpt_meta,
+    )
     from pyrecover_tpu.checkpoint.vanilla import read_ckpt_raw
 
     try:
-        meta, paths, leaves = read_ckpt_raw(path, check_version=False)
+        # full decode (not just the header): inspection doubles as the
+        # integrity read — truncation/corruption lands in the forensics
+        meta, _, _ = read_ckpt_raw(path, check_version=False)
     except Exception as e:
         return _diagnose_corrupt_vanilla(Path(path), e)
     print(f"format: vanilla single-file (v{meta['format']})")
@@ -33,11 +68,7 @@ def inspect_vanilla(path, show_leaves):
             print(f"{k}: {meta[k]}")
     if meta.get("sampler"):
         print(f"sampler state: {meta['sampler']}")
-    total = sum(x.nbytes for x in leaves)
-    print(f"leaves: {len(leaves)} | total {human(total)}")
-    if show_leaves:
-        for p, x in zip(paths, leaves):
-            print(f"  {p}: {x.dtype} {tuple(x.shape)} {human(x.nbytes)}")
+    _print_manifest_rows(manifest_from_ckpt_meta(meta), show_leaves)
     return 0
 
 
@@ -110,12 +141,13 @@ def _diagnose_corrupt_vanilla(path, err):
 
 
 def inspect_sharded(path, show_leaves):
-    import orbax.checkpoint as ocp
+    from pyrecover_tpu.analysis.shardcheck.manifest import read_ckpt_manifest
 
     path = Path(path).absolute()
     print("format: sharded (Orbax/tensorstore) directory")
+    meta_file = path / "meta" / "metadata"
     try:
-        meta = ocp.Checkpointer(ocp.JsonCheckpointHandler()).restore(path / "meta")
+        meta = json.loads(meta_file.read_text()) if meta_file.exists() else {}
         for k in ("step", "epoch"):
             if k in meta:
                 print(f"{k}: {meta[k]}")
@@ -123,32 +155,7 @@ def inspect_sharded(path, show_leaves):
             print(f"sampler state: {meta['sampler']}")
     except Exception as e:
         print(f"warning: meta unreadable: {e}", file=sys.stderr)
-    with ocp.PyTreeCheckpointer() as ckptr:
-        import jax
-
-        tree = ckptr.metadata(path / "state")
-        flat = jax.tree_util.tree_flatten_with_path(
-            tree.tree if hasattr(tree, "tree") else tree
-        )[0]
-        total = 0
-        rows = []
-        for keypath, leaf in flat:
-            shape = tuple(getattr(leaf, "shape", ()) or ())
-            dtype = getattr(leaf, "dtype", None)
-            try:
-                import numpy as np
-
-                nbytes = np.dtype(dtype).itemsize
-                for s in shape:
-                    nbytes *= s
-            except Exception:
-                dtype, nbytes = "?", 0
-            total += nbytes
-            rows.append((jax.tree_util.keystr(keypath), dtype, shape, nbytes))
-        print(f"leaves: {len(rows)} | total {human(total)}")
-        if show_leaves:
-            for name, dtype, shape, nbytes in rows:
-                print(f"  {name}: {dtype} {shape} {human(nbytes)}")
+    _print_manifest_rows(read_ckpt_manifest(path), show_leaves)
 
 
 def main(argv=None):
@@ -161,11 +168,28 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("checkpoint")
     ap.add_argument("--leaves", action="store_true", help="list every leaf")
+    ap.add_argument(
+        "--manifest", action="store_true",
+        help="print the schema manifest JSON (paths/shapes/dtypes/pspecs) "
+        "— the document shardcheck diffs; header read only",
+    )
     args = ap.parse_args(argv)
     p = Path(args.checkpoint)
     if not p.exists():
         print(f"ERROR: {p} does not exist", file=sys.stderr)
         return 2
+    if args.manifest:
+        from pyrecover_tpu.analysis.shardcheck.manifest import (
+            read_ckpt_manifest,
+        )
+
+        try:
+            print(json.dumps(read_ckpt_manifest(p), indent=2))
+        except Exception as e:
+            print(f"ERROR: cannot read manifest: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 1
+        return 0
     if p.is_dir():
         inspect_sharded(p, args.leaves)
         return 0

@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""shardcheck CLI — abstract SPMD preflight validation with a CI gate.
+
+Usage:
+    python tools/shardcheck.py --all-presets --strict          # the CI gate
+    python tools/shardcheck.py --preset llama-8b --fsdp 4 --tp 2 --devices 8
+    python tools/shardcheck.py --preset llama-1b --diff-checkpoint ckpt_100.ckpt
+    python tools/shardcheck.py --list-checks
+
+All logic lives in ``pyrecover_tpu.analysis.shardcheck``; this file is
+the executable shim. It forces the virtual-CPU platform BEFORE jax loads
+so the census can trace under concrete 1..8-device meshes on any host —
+no TPU, no HBM, no compilation.
+"""
+
+import os
+import sys
+from pathlib import Path
+
+# runnable from any cwd, installed or not
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# Preflight is abstract by design: run on virtual CPU devices unless the
+# caller explicitly pinned a platform. XLA latches these at first-client
+# creation, which is why they must be set before any jax import.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if os.environ["JAX_PLATFORMS"] == "cpu" and (
+    "--xla_force_host_platform_device_count"
+    not in os.environ.get("XLA_FLAGS", "")
+):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+from pyrecover_tpu.analysis.shardcheck.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
