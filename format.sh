@@ -57,4 +57,21 @@ else
   rc=1
 fi
 
+# chaos smoke: the recovery stack's soak gate (pyrecover_tpu/resilience).
+# Runs the real tiny-model trainer on CPU under a seeded fault plan —
+# SIGTERM drill, SIGKILL mid-save, transient EIO under the writer, flipped
+# bytes in a committed checkpoint — across kill/resume cycles, and fails
+# on ANY continuity or quarantine violation: the stitched loss CSV must be
+# bit-exact against an uninterrupted golden run, exactly the injected
+# corruption quarantined, and the ckpt_io_retry/ckpt_quarantined telemetry
+# trail present. JSON report at CHAOS_JSON, beside the other gate reports.
+if CHAOS_OUT=$(JAX_PLATFORMS=cpu python tools/chaos.py \
+    --preset smoke --seed 0 \
+    --json "${CHAOS_JSON:-/tmp/chaos_report.json}" 2>&1); then
+  echo "$CHAOS_OUT" | tail -1        # clean: one OK line
+else
+  echo "$CHAOS_OUT"                  # violations: full cycle report
+  rc=1
+fi
+
 exit $rc

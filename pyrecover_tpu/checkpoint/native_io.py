@@ -100,7 +100,10 @@ def tree_hash(data, chunk=DEFAULT_CHUNK, n_threads=0) -> int:
 
 def write_file(path, data: bytes, chunk=DEFAULT_CHUNK, n_threads=0) -> int:
     """Parallel write + checksum-in-the-same-pass. Returns the tree hash."""
+    from pyrecover_tpu.resilience import faults
+
     lib = _load()
+    faults.check("ckpt_write", path=str(path), written=0)
     err = ctypes.c_int(0)
     digest = lib.pr_write_file(str(path).encode(), data, len(data), chunk,
                                n_threads, ctypes.byref(err))
@@ -110,7 +113,10 @@ def write_file(path, data: bytes, chunk=DEFAULT_CHUNK, n_threads=0) -> int:
 
 def read_file(path, chunk=DEFAULT_CHUNK, n_threads=0):
     """Parallel read of the whole file. Returns (bytes, tree_hash)."""
+    from pyrecover_tpu.resilience import faults
+
     lib = _load()
+    faults.check("ckpt_read", path=str(path))
     err = ctypes.c_int(0)
     size = lib.pr_file_size(str(path).encode(), ctypes.byref(err))
     _check(err, "stat", path)

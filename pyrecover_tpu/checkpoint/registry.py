@@ -20,6 +20,8 @@ import re
 import shutil
 from pathlib import Path
 
+from pyrecover_tpu.resilience.quarantine import QUARANTINE_DIRNAME
+
 _CKPT_RE = re.compile(r"^ckpt_(\d+)(_final)?(\.ckpt)?$")
 
 VANILLA_SUFFIX = ".ckpt"
@@ -52,6 +54,12 @@ def list_checkpoints(exp_dir, *, sharded=None):
         return []
     out = []
     for p in exp_dir.iterdir():
+        # quarantined entries live under .corrupt/ and are invisible to
+        # discovery AND retention — a failed checkpoint must never count
+        # against max_keep or shadow `latest` (its name can't match the
+        # pattern either, but the guard keeps the contract explicit)
+        if p.name == QUARANTINE_DIRNAME:
+            continue
         step = parse_step(p)
         if step is None:
             continue
@@ -79,6 +87,9 @@ def prune_checkpoints(exp_dir, max_keep, *, sharded=None):
         return []
     ckpts = list_checkpoints(exp_dir, sharded=sharded)
     doomed = ckpts[:-max_keep] if len(ckpts) > max_keep else []
+    engine = (
+        "sharded" if sharded else "vanilla" if sharded is False else "any"
+    )
     for p in doomed:
         if p.is_dir():
             shutil.rmtree(p, ignore_errors=True)
@@ -87,13 +98,18 @@ def prune_checkpoints(exp_dir, max_keep, *, sharded=None):
             for sidecar in (p.with_suffix(p.suffix + ".sha256"),
                             p.with_suffix(p.suffix + ".md5")):
                 sidecar.unlink(missing_ok=True)
+        from pyrecover_tpu import telemetry
+
+        # one event per removal: retention is destroying durable state, so
+        # every deletion must be individually attributable in the stream
+        telemetry.emit(
+            "ckpt_pruned", engine=engine, path=p.name, step=parse_step(p),
+        )
     if doomed:
         from pyrecover_tpu import telemetry
 
         telemetry.emit(
-            "ckpt_prune",
-            engine="sharded" if sharded else "vanilla" if sharded is False
-            else "any",
+            "ckpt_prune", engine=engine,
             count=len(doomed), removed=[p.name for p in doomed],
         )
     return doomed

@@ -25,6 +25,7 @@ import orbax.checkpoint as ocp
 from pyrecover_tpu import telemetry
 from pyrecover_tpu.checkpoint.registry import prune_checkpoints
 from pyrecover_tpu.checkpoint.vanilla import CheckpointStructureError
+from pyrecover_tpu.resilience import faults
 from pyrecover_tpu.utils.logging import log_host0
 
 
@@ -50,6 +51,7 @@ class ShardedCheckpointer:
             "ckpt_save_start", engine="sharded", path=str(path),
             async_=self.use_async,
         )
+        faults.check("ckpt_save_begin", engine="sharded", path=str(path))
         # same schema manifest the vanilla engine embeds (one schema,
         # two producers): preflight/resume diff it without tensor reads
         from pyrecover_tpu.analysis.shardcheck.manifest import state_manifest
@@ -68,6 +70,9 @@ class ShardedCheckpointer:
             ),
             force=True,
         )
+        # async saves: dispatch accepted (durability is wait()'s business);
+        # sync saves: the directory is committed at this point
+        faults.check("ckpt_commit", engine="sharded", path=str(path))
         if max_keep:
             # prune only already-finalized checkpoints; the in-flight save's
             # tmp dir is invisible to the registry until orbax renames it.

@@ -43,6 +43,8 @@ from flax.serialization import msgpack_restore
 from pyrecover_tpu import telemetry
 from pyrecover_tpu.checkpoint.registry import prune_checkpoints
 from pyrecover_tpu.parallel.mesh import sync_global_devices
+from pyrecover_tpu.resilience import faults
+from pyrecover_tpu.resilience.retry import io_retry
 from pyrecover_tpu.utils.logging import log_host0
 
 FORMAT_VERSION = 2
@@ -216,6 +218,7 @@ def save_ckpt_vanilla(path, state, sampler_state=None, *, verify=False,
         "ckpt_save_start", engine="vanilla", path=str(path),
         background=bool(background),
     )
+    faults.check("ckpt_save_begin", engine="vanilla", path=str(path))
     sync_global_devices("vanilla_save_enter")
 
     # schema manifest (paths/shapes/dtypes/pspecs): the single cross-
@@ -312,6 +315,7 @@ def _write_stream(path, leaves_iter, meta, verify, max_keep):
     non-contiguous."""
     t0 = time.monotonic()
     written = 0
+    path_s = str(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     meta_b = json.dumps(meta).encode()
     checksum = _IncrementalChecksum() if verify else None
@@ -319,12 +323,24 @@ def _write_stream(path, leaves_iter, meta, verify, max_keep):
     try:
         with os.fdopen(fd, "wb", buffering=4 * 1024 * 1024) as f:
 
+            def _write_once(b):
+                # the injection seam raises BEFORE the real write, so a
+                # retried chunk is never half-applied by the fault itself;
+                # a real transient EIO leaves the buffered writer's state
+                # to the retry — the best available recovery either way
+                faults.check("ckpt_write", path=path_s, written=written)
+                f.write(b)
+
             def w(b):
                 nonlocal written
-                f.write(b)
+                io_retry(lambda: _write_once(b), op="write", path=path_s)
                 written += len(b)
                 if checksum is not None:
                     checksum.update(b)
+
+            def _fsync_once():
+                faults.check("ckpt_fsync", path=path_s)
+                os.fsync(f.fileno())
 
             w(MAGIC)
             w(len(meta_b).to_bytes(8, "little"))
@@ -338,12 +354,25 @@ def _write_stream(path, leaves_iter, meta, verify, max_keep):
                 for off in range(0, len(data), _HASH_CHUNK):
                     w(data[off : off + _HASH_CHUNK])
                 del data
-        os.replace(tmp, path)  # atomic publish
+            # durability BEFORE the atomic publish: a power cut after the
+            # rename must not leave `latest` pointing at unsynced pages
+            f.flush()
+            io_retry(_fsync_once, op="fsync", path=path_s)
+
+        def _rename_once():
+            faults.check("ckpt_rename", path=path_s)
+            os.replace(tmp, path)  # atomic publish
+
+        io_retry(_rename_once, op="rename", path=path_s)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
     if verify:
-        _sidecar(path).write_text(checksum.result())
+        io_retry(
+            lambda: _sidecar(path).write_text(checksum.result()),
+            op="sidecar", path=path_s,
+        )
+    faults.check("ckpt_commit", engine="vanilla", path=path_s)
     telemetry.emit(
         "ckpt_commit", engine="vanilla", path=str(path), bytes=written,
         write_s=round(time.monotonic() - t0, 4), checksum=bool(verify),
@@ -366,10 +395,14 @@ def read_ckpt_raw(path, *, check_version=True):
     from pyrecover_tpu.checkpoint import native_io
 
     path = Path(path)
-    if native_io.available():
-        data, _ = native_io.read_file(path)  # parallel pread
-    else:
-        data = path.read_bytes()
+
+    def _read_once():
+        faults.check("ckpt_read", path=str(path))
+        if native_io.available():
+            return native_io.read_file(path)[0]  # parallel pread
+        return path.read_bytes()
+
+    data = io_retry(_read_once, op="read", path=str(path))
     return _decode_ckpt_bytes(data, check_version=check_version)
 
 

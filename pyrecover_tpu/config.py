@@ -33,6 +33,9 @@ class TrainConfig:
     # of right-padding each one like the reference (dataset.py:29-35) —
     # training-tokens % becomes ~100 by construction
     pack_sequences: bool = False
+    # seconds without a batch before the loader raises LoaderStallError
+    # instead of wedging the step loop forever; 0 disables the watchdog
+    loader_stall_timeout: float = 0.0
     sequence_length: int = 2048
     batch_size: int = 1  # GLOBAL batch size (reference train.py:62-63 semantics)
     training_samples: int = 0  # 0 → len(dataset); else wraparound like ref dataset.py:25
@@ -158,6 +161,12 @@ def build_parser():
                    help="Pack multiple documents per row (segment-masked "
                         "attention) instead of right-padding each one; "
                         "training-tokens %% becomes ~100.")
+    p.add_argument("--loader-stall-timeout", type=float,
+                   default=d.loader_stall_timeout,
+                   help="Seconds without a batch before the data loader "
+                        "raises LoaderStallError (emitting a "
+                        "loader_stall_timeout telemetry event) instead of "
+                        "hanging the step loop. 0 disables the watchdog.")
     p.add_argument("--sequence-length", type=int, default=d.sequence_length)
     p.add_argument("--batch-size", type=int, default=d.batch_size,
                    help="GLOBAL batch size, sharded over the data axis.")
@@ -318,6 +327,7 @@ def get_args(argv=None):
         dataset=ns.dataset,
         tokenizer_name_or_path=ns.tokenizer_name_or_path,
         pack_sequences=ns.pack_sequences,
+        loader_stall_timeout=ns.loader_stall_timeout,
         sequence_length=ns.sequence_length,
         batch_size=ns.batch_size,
         training_samples=ns.training_samples,

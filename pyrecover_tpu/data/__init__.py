@@ -1,6 +1,12 @@
 from pyrecover_tpu.data.collate import collate_clm
-from pyrecover_tpu.data.loader import DataLoader
+from pyrecover_tpu.data.loader import DataLoader, LoaderStallError
 from pyrecover_tpu.data.sampler import StatefulSampler
 from pyrecover_tpu.data.synthetic import SyntheticTextDataset
 
-__all__ = ["collate_clm", "DataLoader", "StatefulSampler", "SyntheticTextDataset"]
+__all__ = [
+    "collate_clm",
+    "DataLoader",
+    "LoaderStallError",
+    "StatefulSampler",
+    "SyntheticTextDataset",
+]

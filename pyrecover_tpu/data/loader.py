@@ -25,21 +25,32 @@ from jax.sharding import NamedSharding
 from pyrecover_tpu import telemetry
 from pyrecover_tpu.data.collate import collate_clm
 from pyrecover_tpu.parallel.sharding import batch_pspec
+from pyrecover_tpu.resilience import faults
 
 # a consumer wait above this is a real stall (the prefetch queue ran dry),
 # not scheduler noise — emitted as a `data_stall` telemetry event
 _STALL_EVENT_THRESHOLD_S = 1e-3
 
 
+class LoaderStallError(RuntimeError):
+    """The prefetch pipeline produced nothing for ``stall_timeout``
+    seconds: a wedged data source (hung filesystem, dead tokenizer
+    worker). Raised instead of blocking the step loop forever so the
+    trainer can fail fast inside its preemption grace window — a hang
+    here would otherwise eat the whole deadline with no checkpoint."""
+
+
 class DataLoader:
     def __init__(self, dataset, sampler, pad_token_id, mesh=None,
-                 prefetch=2, num_workers=4):
+                 prefetch=2, num_workers=4, stall_timeout=0.0):
         self.dataset = dataset
         self.sampler = sampler
         self.pad_token_id = pad_token_id
         self.mesh = mesh
         self.prefetch = max(int(prefetch), 0)
         self.num_workers = max(int(num_workers), 1)
+        # 0 disables: blocking waits are legitimate on cold start
+        self.stall_timeout = max(float(stall_timeout), 0.0)
         self._queue = None
         self._thread = None
         self._stop = threading.Event()
@@ -65,6 +76,9 @@ class DataLoader:
         return global_indices[p * per : (p + 1) * per]
 
     def _make_batch(self, global_indices):
+        # fault seam: `loader_stall` wedges exactly here — host-side batch
+        # materialization — which is what a hung data source looks like
+        faults.check("loader_batch", batch=self.batches_served + 1)
         local = self._local_indices(global_indices)
         items = [self.dataset[i] for i in local]
         batch = collate_clm(items, self.pad_token_id)
@@ -134,7 +148,26 @@ class DataLoader:
                 # is now stalled on host-side tokenize/collate — the exact
                 # signal that says "add workers / deepen prefetch"
                 t0 = time.monotonic()
-                item = self._queue.get()
+                try:
+                    item = self._queue.get(
+                        timeout=self.stall_timeout or None
+                    )
+                except queue.Empty:
+                    # the stall watchdog: a wedged producer becomes a typed
+                    # error the trainer can act on, not an eternal hang
+                    waited = time.monotonic() - t0
+                    self.stall_count += 1
+                    self.stall_s += waited
+                    telemetry.emit(
+                        "loader_stall_timeout", wait_s=round(waited, 3),
+                        timeout_s=self.stall_timeout,
+                        batch=self.batches_served + 1,
+                    )
+                    raise LoaderStallError(
+                        f"data loader produced no batch for {waited:.1f} s "
+                        f"(--loader-stall-timeout {self.stall_timeout:g} s) "
+                        f"at batch {self.batches_served + 1}"
+                    ) from None
                 waited = time.monotonic() - t0
                 self.stall_count += 1
                 self.stall_s += waited

@@ -32,11 +32,13 @@ left spinning.
 
 import os
 import threading
+import time
 import urllib.error
 import urllib.request
 from pathlib import Path
 
 from pyrecover_tpu import telemetry
+from pyrecover_tpu.resilience import faults
 from pyrecover_tpu.utils.logging import log_host0
 
 # Default per GCE contract; tests override via $PYRECOVER_METADATA_BASE.
@@ -74,12 +76,25 @@ class MaintenanceEventWatcher:
     """
 
     def __init__(self, on_event=None, notice_file=None, base=None,
-                 poll_timeout_s=10, max_consecutive_errors=3):
+                 poll_timeout_s=10, max_consecutive_errors=3,
+                 backoff_base_s=2.0, read_timeout_s=10.0,
+                 hang_timeout_s=None):
         self.on_event = on_event
         self.notice_file = Path(notice_file) if notice_file else None
         self.base = (base or metadata_base()).rstrip("/")
         self.poll_timeout_s = poll_timeout_s
         self.max_consecutive_errors = max_consecutive_errors
+        # error-retry schedule: backoff_base_s·2^k, ceiling poll_timeout_s
+        # (the docstring's blind-window contract); history kept for tests
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_history = []
+        # plain (non-long-poll) request timeout; production default 10 s
+        self.read_timeout_s = float(read_timeout_s)
+        # a request that consumed (at least) this much wall time before
+        # failing is a HANG (wedged server / black-holed route), not a
+        # refusal; None = the request's own socket timeout
+        self.hang_timeout_s = hang_timeout_s
+        self.degraded = False  # was healthy, currently failing
         self.event_seen = None  # description string once fired
         self._stop = threading.Event()
         self._thread = None
@@ -141,25 +156,46 @@ class MaintenanceEventWatcher:
         if self.on_event is not None:
             self.on_event(description)
 
+    def _recovered(self):
+        """A request succeeded after the degraded transition: maintenance
+        detection is whole again — say so, the silence was a liability."""
+        if self.degraded:
+            self.degraded = False
+            log_host0("metadata server recovered; maintenance-event "
+                      "detection restored")
+            telemetry.emit("maintenance_recovered")
+
     def _run(self):
         errors = 0
         ever_ok = False  # has ANY request ever succeeded?
         etag = None
         while not self._stop.is_set() and self.event_seen is None:
+            # per-iteration request bookkeeping for the hang watchdog: a
+            # failure that BURNED its whole socket timeout is a wedge
+            # (server accepted, never answered), not a refusal
+            t_req = time.monotonic()
+            req_timeout = self.read_timeout_s
             try:
+                # fault seam: `metadata_flap` injects poll failures here
+                faults.check("metadata_poll", base=self.base)
                 # preempted is a plain read (no etag churn): spot/queued-
                 # resource reclaims flip it without a maintenance-event
-                val, _ = self._get("instance/preempted", timeout=10)
+                val, _ = self._get(
+                    "instance/preempted", timeout=self.read_timeout_s
+                )
                 errors = 0  # any successful request proves the server lives
                 ever_ok = True
+                self._recovered()
                 if val.upper() == "TRUE":
                     self._fire("instance/preempted=TRUE")
                     return
                 # hanging long-poll on maintenance-event; first call (no
                 # etag) returns immediately with the current value+etag
+                t_req = time.monotonic()
+                req_timeout = self.poll_timeout_s + 30
                 val, etag = self._get(
                     "instance/maintenance-event", etag=etag,
-                    timeout=self.poll_timeout_s + 30,
+                    timeout=req_timeout,
                 )
                 errors = 0
                 if val.upper() in _ACTIONABLE:
@@ -167,6 +203,25 @@ class MaintenanceEventWatcher:
                     return
             except (urllib.error.URLError, OSError, ValueError):
                 errors += 1
+                hang_after = (
+                    self.hang_timeout_s
+                    if self.hang_timeout_s is not None else req_timeout
+                )
+                wedged_s = time.monotonic() - t_req
+                if wedged_s >= hang_after * 0.999:
+                    # the hang watchdog: the decision path is a separate
+                    # thread so nothing blocked, but a wedged server means
+                    # the run is flying deadline-only — make that loud
+                    log_host0(
+                        "metadata request hung for %.1f s before failing "
+                        "(wedged server?); preemption detection degrades "
+                        "to deadline/signal-only until it recovers",
+                        wedged_s, level=30,  # WARNING
+                    )
+                    telemetry.emit(
+                        "maintenance_watcher_hang",
+                        seconds=round(wedged_s, 3), errors=errors,
+                    )
                 if not ever_ok:
                     # the server was NEVER reachable: not on GCE — retire
                     # quietly after a few tries, no thread left spinning
@@ -185,6 +240,7 @@ class MaintenanceEventWatcher:
                     # WAS healthy, now erroring: a network blip mid-job must
                     # not silently disable maintenance detection for the
                     # rest of the run — keep retrying with capped backoff
+                    self.degraded = True
                     log_host0(
                         "metadata server was healthy but has failed %d "
                         "consecutive requests; retrying with capped backoff "
@@ -194,4 +250,9 @@ class MaintenanceEventWatcher:
                     telemetry.emit("maintenance_degraded", errors=errors)
                 # backoff ceiling stays poll_timeout_s (docstring contract):
                 # the blind window must remain inside GCE's ~30 s spot grace
-                self._stop.wait(min(2.0 ** min(errors, 6), self.poll_timeout_s))
+                delay = min(
+                    self.backoff_base_s * (2.0 ** min(errors - 1, 6)),
+                    self.poll_timeout_s,
+                )
+                self.backoff_history.append(delay)
+                self._stop.wait(delay)

@@ -79,6 +79,12 @@ class PreemptionWatcher:
         self._signal_seen = False
         self._notice_logged = False
         self._maintenance_watcher = None
+        self.signal_count = 0
+        self._handler_installed = False
+        # (exp_dir, step) while a deferred-exit save is in flight; None
+        # otherwise. A second signal while armed escalates immediately.
+        self._escalation = None
+        self._exit_fn = os._exit  # swappable for tests
         if self.enabled:
             if self.job_end_time is not None:
                 log_host0(
@@ -121,17 +127,61 @@ class PreemptionWatcher:
     def install_signal_handler(self):
         """SIGTERM/SIGUSR1 → treat as a preemption notice. Cloud TPU
         maintenance sends SIGTERM ahead of eviction; SLURM can be configured
-        to send SIGUSR1 before the wall limit."""
+        to send SIGUSR1 before the wall limit.
+
+        Idempotent (re-installing never stacks handlers) and counting: the
+        FIRST signal requests the graceful final-checkpoint path; a SECOND
+        signal while a deferred-exit save is armed (``arm_escalation``)
+        means the scheduler is out of patience — escalate to an immediate
+        requeue marker + exit instead of gambling that the in-flight save
+        outruns the kill."""
+        if self._handler_installed:
+            return self
 
         def handler(signum, frame):
+            self.signal_count += 1
             self._signal_seen = True
+            if self.signal_count >= 2 and self._escalation is not None:
+                self._escalate(signum)
 
         signal.signal(signal.SIGTERM, handler)
         try:
             signal.signal(signal.SIGUSR1, handler)
         except (ValueError, OSError):
             pass
+        self._handler_installed = True
         return self
+
+    # -- deferred-exit escalation --------------------------------------------
+    def arm_escalation(self, exp_dir, step):
+        """Mark a save in flight: a repeat signal now escalates. ``step``
+        is the last completed global step — what the requeue marker must
+        publish so the relaunch resumes with honest replay accounting."""
+        self._escalation = (Path(exp_dir), int(step))
+        return self
+
+    def disarm_escalation(self):
+        self._escalation = None
+
+    def _escalate(self, signum):
+        """Second signal mid-save: publish the requeue marker NOW and exit.
+        Runs inside the signal handler (main thread, between bytecodes) —
+        ``os._exit`` skips interpreter teardown deliberately: the process
+        is being killed either way, and a clean-looking partial shutdown
+        is worse for the post-mortem than an honest hard exit."""
+        exp_dir, step = self._escalation
+        telemetry.emit(
+            "preempt_signal_escalation", signal=int(signum),
+            count=self.signal_count, step=step,
+        )
+        log_host0(
+            "second signal (%d) during the final save; escalating: "
+            "requeue marker written, exiting now", signum, level=30,
+        )
+        try:
+            write_requeue_marker(exp_dir, done=False, step=step)
+        finally:
+            self._exit_fn(75)  # EX_TEMPFAIL: retryable, the launcher requeues
 
     def start_maintenance_watcher(self):
         """Start the Cloud TPU maintenance-event producer (maintenance.py):

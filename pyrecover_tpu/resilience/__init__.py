@@ -1,0 +1,38 @@
+"""pyrecover_tpu.resilience — deterministic fault injection + hardened recovery.
+
+The paper's headline claim is *resilient* pre-training, so failure must be
+a reproducible input, not a hope. This package holds the three pieces:
+
+  * ``faults`` — a seeded, declarative fault-injection engine. A fault
+    plan (JSON via ``$PYRECOVER_FAULT_PLAN`` or ``faults.install``) maps
+    fault specs (``sigterm_at_step``, ``kill9_during_save``,
+    ``corrupt_ckpt_bytes``, ``transient_io_error``, ``loader_stall``,
+    ``metadata_flap``) onto explicit injection *seams*
+    (``faults.check(site, **ctx)``) threaded through the checkpoint
+    engines, the data loader, the preemption stack, and the maintenance
+    watcher. With no plan active every seam is a rebound no-op.
+  * ``retry`` — capped exponential backoff + jitter for transient
+    checkpoint I/O errors (``ckpt_io_retry`` telemetry per attempt).
+  * ``quarantine`` — atomic sidecar-move of checkpoints that fail their
+    integrity pre-check into ``<exp_dir>/.corrupt/`` so the latest-resume
+    fallback walks back to the newest *good* checkpoint instead of
+    crash-looping on the same bad file every restart.
+
+``tools/chaos.py`` (module ``resilience.chaos``) is the soak harness that
+kills/corrupts/resumes a real tiny-model trainer under a seeded plan and
+asserts bit-exact stitched-loss continuity against an uninterrupted run.
+"""
+
+from pyrecover_tpu.resilience import faults
+from pyrecover_tpu.resilience.quarantine import (
+    QUARANTINE_DIRNAME,
+    quarantine_checkpoint,
+)
+from pyrecover_tpu.resilience.retry import io_retry
+
+__all__ = [
+    "faults",
+    "io_retry",
+    "quarantine_checkpoint",
+    "QUARANTINE_DIRNAME",
+]

@@ -1,0 +1,316 @@
+"""Chaos soak harness: kill/corrupt/resume a real trainer, prove continuity.
+
+The recovery paths (SIGTERM mid-run, SIGKILL mid-save, flipped bytes in a
+committed checkpoint, transient EIO under the writer) are only trustworthy
+if a machine exercises them the way production does: against a real
+training process, across real restarts, judged by the artifact that
+matters — the stitched per-step loss curve. This harness runs the tiny
+model trainer as a subprocess under a seeded fault plan
+(``resilience.faults`` via ``$PYRECOVER_FAULT_PLAN``), cycles through
+kill→resume, and diffs the surviving loss CSV row-for-row against an
+uninterrupted golden run with the same seed. Bit-exact or it fails.
+
+A smoke soak is four trainer runs over one experiment directory::
+
+    golden   : fresh, no faults, steps 1..N           -> reference CSV
+    cycle 1  : fresh, SIGTERM as step s1 begins       -> final ckpt @ s1
+    cycle 2  : resume, SIGKILL mid-checkpoint-write   -> torn tmp, rc -9
+    cycle 3  : resume, transient EIO absorbed by the retry path, SIGTERM
+               at s2, then the *final* checkpoint's bytes flipped
+    cycle 4  : resume, no faults: quarantines the corrupt checkpoint,
+               falls back to the newest good one, finishes, DONE marker
+
+Verdicts: per-cycle exit codes, stitched CSV == golden CSV, exactly the
+injected corruption quarantined (zero non-injected losses), and the
+``ckpt_io_retry`` / ``ckpt_quarantined`` / ``fault_injected`` telemetry
+trail present. The JSON report (``--json`` / ``$CHAOS_JSON``) carries the
+seed — rerunning with the same seed reproduces the same schedule.
+"""
+
+import argparse
+import json
+import os
+import random
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+from pyrecover_tpu.resilience.quarantine import list_quarantined
+from pyrecover_tpu.telemetry import read_events
+
+CHAOS_JSON_ENV = "CHAOS_JSON"
+
+_TINY_MODEL_ARGS = (
+    "--model-dim", "64", "--model-layers", "2", "--model-heads", "4",
+    "--model-kv-heads", "2", "--vocab-size", "128",
+)
+
+PRESETS = {
+    # CI-speed: 2 fault kinds per kill cycle, tiny model, CPU, ~4 runs
+    "smoke": dict(
+        training_steps=10, checkpoint_frequency=3, batch_size=8,
+        sequence_length=32, training_samples=64, run_timeout_s=240,
+    ),
+    # longer soak for local qualification: more steps, same protocol
+    "soak": dict(
+        training_steps=30, checkpoint_frequency=5, batch_size=8,
+        sequence_length=32, training_samples=64, run_timeout_s=600,
+    ),
+}
+
+
+def _trainer_cmd(preset, exp, seed, workdir, *, resume=False):
+    cmd = [
+        sys.executable, "-m", "pyrecover_tpu.train",
+        "--training-steps", str(preset["training_steps"]),
+        "--batch-size", str(preset["batch_size"]),
+        "--sequence-length", str(preset["sequence_length"]),
+        "--training-samples", str(preset["training_samples"]),
+        "--learning-rate", "1e-3", "--lr-warmup-steps", "2",
+        "--seed", str(seed),
+        "--checkpoint-dir", str(workdir),
+        "--experiment_name", exp,
+        "--checkpoint-frequency", str(preset["checkpoint_frequency"]),
+        # sync (and flush the loss CSV) every step: the post-kill CSV must
+        # carry every completed step, that is the artifact under test
+        "--logging-frequency", "1000000",
+        "--preempt-check-interval", "1",
+        "--timeaware-checkpointing",
+        "--log-loss-to-csv", "--telemetry",
+        "--verify-checkpoints",  # checksum sidecars make corruption visible
+        "--no-async-checkpoint",
+        *_TINY_MODEL_ARGS,
+    ]
+    if resume:
+        cmd += ["--resume-from-checkpoint", "latest"]
+    return cmd
+
+
+def _run_trainer(cmd, *, fault_plan, log_path, timeout_s):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # no accelerator plugin probing
+    if fault_plan is not None:
+        env["PYRECOVER_FAULT_PLAN"] = json.dumps(fault_plan)
+    else:
+        env.pop("PYRECOVER_FAULT_PLAN", None)
+    t0 = time.monotonic()
+    with open(log_path, "ab") as logf:
+        logf.write(("\n==== " + " ".join(cmd) + "\n").encode())
+        logf.flush()
+        proc = subprocess.run(
+            cmd, env=env, stdout=logf, stderr=subprocess.STDOUT,
+            timeout=timeout_s,
+        )
+    return proc.returncode, round(time.monotonic() - t0, 2)
+
+
+def _read_csv_rows(path):
+    path = Path(path)
+    if not path.exists():
+        return []
+    return [ln for ln in path.read_text().splitlines() if ln.strip()]
+
+
+def _schedule(preset, seed):
+    """The seeded fault schedule: (s1, s2) SIGTERM steps. Reproducing a
+    soak failure = rerunning with the seed printed in its report."""
+    rng = random.Random(seed)
+    freq = preset["checkpoint_frequency"]
+    steps = preset["training_steps"]
+    # s1 lands around the first periodic save; s2 after the second one but
+    # before the third, so cycle 3's final save is save #2 of that run
+    s1 = rng.randint(freq, freq + 2)
+    s2 = rng.randint(2 * freq + 1, min(3 * freq - 1, steps - 2))
+    return s1, s2
+
+
+def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
+    """Run the kill/corrupt/resume soak. Returns the report dict
+    (``report["ok"]`` is the gate verdict)."""
+    preset = PRESETS[preset_name]
+    owns_workdir = workdir is None
+    workdir = Path(workdir or tempfile.mkdtemp(prefix="pyrecover_chaos_"))
+    workdir.mkdir(parents=True, exist_ok=True)
+    log_path = workdir / "chaos_runs.log"
+    s1, s2 = _schedule(preset, seed)
+    steps = preset["training_steps"]
+    timeout = preset["run_timeout_s"]
+    violations = []
+    cycles = []
+
+    def cycle(name, *, fault_plan, resume, expect_rc, exp="chaos"):
+        cmd = _trainer_cmd(preset, exp, seed, workdir, resume=resume)
+        try:
+            rc, secs = _run_trainer(
+                cmd, fault_plan=fault_plan, log_path=log_path,
+                timeout_s=timeout,
+            )
+        except subprocess.TimeoutExpired:
+            rc, secs = "timeout", timeout
+        ok = rc in expect_rc
+        if not ok:
+            violations.append(
+                f"cycle {name}: exit code {rc}, expected one of {expect_rc}"
+            )
+        cycles.append({"name": name, "rc": rc, "seconds": secs, "ok": ok,
+                       "faults": (fault_plan or {}).get("faults", [])})
+        return ok
+
+    # golden: the uninterrupted reference curve, same seed, own exp dir
+    cycle("golden", fault_plan=None, resume=False, expect_rc=(0,),
+          exp="golden")
+
+    # cycle 1 — graceful preemption drill: SIGTERM as step s1 begins
+    cycle("sigterm", resume=False, expect_rc=(0,), fault_plan={
+        "seed": seed,
+        "faults": [{"type": "sigterm_at_step", "step": s1}],
+    })
+
+    # cycle 2 — hard kill mid-save: SIGKILL inside the first periodic
+    # checkpoint write of the resumed run (rc is -SIGKILL)
+    cycle("kill9_during_save", resume=True, expect_rc=(-9, 137),
+          fault_plan={
+              "seed": seed,
+              "faults": [{"type": "kill9_during_save", "save_index": 1}],
+          })
+
+    # cycle 3 — transient EIO under the writer (absorbed by retry), then
+    # SIGTERM at s2 and the final checkpoint's committed bytes flipped
+    cycle("transient_io+corrupt", resume=True, expect_rc=(0,), fault_plan={
+        "seed": seed,
+        "faults": [
+            {"type": "transient_io_error", "op": "write", "fail_count": 2},
+            {"type": "sigterm_at_step", "step": s2},
+            {"type": "corrupt_ckpt_bytes", "save_index": 2, "count": 64},
+        ],
+    })
+
+    # cycle 4 — recovery run: must quarantine the corrupt checkpoint,
+    # fall back to the newest good one, and finish the full step budget
+    cycle("recover_and_finish", resume=True, expect_rc=(0,),
+          fault_plan=None)
+
+    exp_dir = workdir / "chaos"
+    golden_rows = _read_csv_rows(
+        workdir / "golden" / "golden_loss_log.csv"
+    )
+    stitched_rows = _read_csv_rows(exp_dir / "chaos_loss_log.csv")
+    first_divergence = None
+    for i, (a, b) in enumerate(zip(golden_rows, stitched_rows)):
+        if a != b:
+            first_divergence = {"row": i, "golden": a, "stitched": b}
+            break
+    continuity_ok = (
+        first_divergence is None
+        and len(golden_rows) == len(stitched_rows)
+        and len(golden_rows) == steps + 1  # header + every step
+    )
+    if not continuity_ok:
+        violations.append(
+            "loss continuity broken: "
+            + (json.dumps(first_divergence) if first_divergence else
+               f"{len(stitched_rows)} stitched rows vs "
+               f"{len(golden_rows)} golden (want {steps + 1})")
+        )
+
+    if not (exp_dir / "DONE").exists():
+        violations.append("no DONE marker after the recovery cycle")
+
+    quarantined = [p.name for p in list_quarantined(exp_dir)]
+    # zero lost checkpoints: exactly the one injected corruption is
+    # quarantined — anything else means recovery ate a good checkpoint
+    if len(quarantined) != 1:
+        violations.append(
+            f"expected exactly the injected corruption quarantined, got "
+            f"{quarantined}"
+        )
+    elif not quarantined[0].startswith(f"ckpt_{s2}_final"):
+        violations.append(
+            f"quarantined {quarantined[0]}, expected ckpt_{s2}_final*"
+        )
+
+    events = read_events(exp_dir / "chaos_telemetry.jsonl")
+    counts = {}
+    for e in events:
+        counts[e["event"]] = counts.get(e["event"], 0) + 1
+    for required in ("ckpt_io_retry", "ckpt_quarantined", "fault_injected",
+                     "ckpt_precheck_failed"):
+        if not counts.get(required):
+            violations.append(f"no {required} telemetry event recorded")
+
+    report = {
+        "preset": preset_name,
+        "seed": seed,
+        "schedule": {"sigterm_step_1": s1, "sigterm_step_2": s2},
+        "workdir": str(workdir),
+        "cycles": cycles,
+        "kill_resume_cycles": sum(
+            1 for c in cycles if any(
+                f["type"] in ("sigterm_at_step", "kill9_during_save")
+                for f in c["faults"]
+            )
+        ),
+        "continuity_ok": continuity_ok,
+        "first_divergence": first_divergence,
+        "rows": len(stitched_rows),
+        "quarantined": quarantined,
+        "telemetry_counts": {
+            k: counts.get(k, 0)
+            for k in ("fault_injected", "ckpt_io_retry", "ckpt_quarantined",
+                      "ckpt_precheck_failed", "ckpt_pruned", "ckpt_saved",
+                      "resume")
+        },
+        "violations": violations,
+        "ok": not violations,
+    }
+    if json_out:
+        Path(json_out).parent.mkdir(parents=True, exist_ok=True)
+        Path(json_out).write_text(json.dumps(report, indent=2))
+    if report["ok"] and owns_workdir:
+        shutil.rmtree(workdir, ignore_errors=True)
+        report["workdir"] = None  # removed; the log died with it
+    return report
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="pyrecover chaos soak: kill/corrupt/resume a real "
+                    "trainer under a seeded fault plan and verify "
+                    "bit-exact loss continuity",
+    )
+    p.add_argument("--preset", choices=sorted(PRESETS), default="smoke")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--workdir", default=None,
+                   help="experiment directory (kept); default: a temp dir, "
+                        "removed on success, kept on failure")
+    p.add_argument("--json", default=os.environ.get(CHAOS_JSON_ENV) or None,
+                   help=f"JSON report path (default ${CHAOS_JSON_ENV})")
+    args = p.parse_args(argv)
+
+    report = run_soak(
+        args.preset, seed=args.seed, workdir=args.workdir,
+        json_out=args.json,
+    )
+    for c in report["cycles"]:
+        print(f"  cycle {c['name']:<22} rc={c['rc']!s:>4}  "
+              f"{c['seconds']}s  {'ok' if c['ok'] else 'FAIL'}")
+    print(f"  continuity: {'bit-exact' if report['continuity_ok'] else 'BROKEN'}"
+          f" ({report['rows']} rows) | quarantined: {report['quarantined']}"
+          f" | retries: {report['telemetry_counts']['ckpt_io_retry']}")
+    if report["violations"]:
+        for v in report["violations"]:
+            print(f"  VIOLATION: {v}")
+        print(f"chaos: FAIL (seed {report['seed']}, workdir kept at "
+              f"{report['workdir']})")
+        return 1
+    print(f"chaos: OK — {report['kill_resume_cycles']} kill/resume cycles, "
+          f"losses bit-exact vs golden (seed {report['seed']})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

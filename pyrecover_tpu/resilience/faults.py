@@ -1,0 +1,369 @@
+"""Deterministic fault injection: seeded plans delivered through explicit seams.
+
+Recovery code that is never exercised by a real failure silently rots
+(PAPERS.md: fault-tolerant ML multiprocessor work; TorchTitan treats
+recoverability as continuously verified). This module makes failure an
+*input*: a declarative fault plan names what breaks, where, and when —
+and the same seed reproduces the same failure schedule bit-for-bit.
+
+Plan format (JSON — inline in ``$PYRECOVER_FAULT_PLAN`` or a file path)::
+
+    {"seed": 0, "faults": [
+        {"type": "sigterm_at_step", "step": 4},
+        {"type": "kill9_during_save", "save_index": 1, "after_bytes": 0},
+        {"type": "corrupt_ckpt_bytes", "save_index": 2,
+         "offset": null, "count": 64},
+        {"type": "transient_io_error", "op": "write", "fail_count": 2},
+        {"type": "loader_stall", "seconds": 5.0, "batch": 3},
+        {"type": "metadata_flap", "fail_count": 3, "after_ok": 2}
+    ]}
+
+Injection sites (``check(site, **ctx)`` seams placed in production code):
+
+    train_step        train.py hot loop   ctx: step (the step about to run)
+    ckpt_save_begin   both engines' save  ctx: engine, path (bumps save index)
+    ckpt_write        vanilla stream / native_io write   ctx: path, written
+    ckpt_fsync        vanilla stream pre-publish         ctx: path
+    ckpt_rename       vanilla atomic publish             ctx: path
+    ckpt_commit       after a save is durable            ctx: engine, path
+    ckpt_read         vanilla/native read path           ctx: path
+    loader_batch      data loader batch materialization  ctx: batch
+    metadata_poll     maintenance watcher poll loop      ctx: base
+
+With no plan active, ``check`` is rebound to a no-op — seams cost one
+attribute lookup and an empty call. The first ``check`` after import
+resolves ``$PYRECOVER_FAULT_PLAN`` exactly once (so subprocess trainers
+pick their plan up with zero wiring), then rebinds.
+"""
+
+import errno
+import json
+import os
+import signal
+import threading
+import time
+
+from pyrecover_tpu import telemetry
+
+PLAN_ENV = "PYRECOVER_FAULT_PLAN"
+
+
+class FaultPlanError(ValueError):
+    """The fault plan is malformed (unknown type / bad field). Raised at
+    install time, never from a seam — a typo'd plan must fail the run
+    loudly, not silently inject nothing."""
+
+
+def _injected_os_error(what):
+    return OSError(errno.EIO, f"injected fault: {what}")
+
+
+class _Fault:
+    """One armed fault. Subclasses declare ``sites`` and implement
+    ``should_fire(engine, site, ctx) -> bool`` (counter mutations only —
+    runs under the engine lock) and ``execute(engine, site, ctx)`` (the
+    action: sleep/kill/raise — runs OUTSIDE the lock so a stalling fault
+    can't wedge seams on other threads)."""
+
+    sites = ()
+    type_name = ""
+
+    def __init__(self, spec):
+        self.spec = dict(spec)
+        self.hits = 0
+        self.fired = 0
+
+    def maybe_fire(self, engine, site, ctx):
+        with engine._lock:
+            self.hits += 1
+            if not self.should_fire(engine, site, ctx):
+                return
+            self.fired += 1
+        self.execute(engine, site, ctx)
+
+    def _announce(self, site, **detail):
+        telemetry.emit(
+            "fault_injected", type=self.type_name, site=site, **detail
+        )
+
+    def should_fire(self, engine, site, ctx):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def execute(self, engine, site, ctx):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class _SigtermAtStep(_Fault):
+    """Deliver SIGTERM to this process as step N begins — the graceful
+    preemption drill. The trainer's handler turns it into a final
+    checkpoint + REQUEUE exit."""
+
+    sites = ("train_step",)
+    type_name = "sigterm_at_step"
+
+    def __init__(self, spec):
+        super().__init__(spec)
+        self.step = int(spec["step"])
+
+    def should_fire(self, engine, site, ctx):
+        return not self.fired and ctx.get("step") == self.step
+
+    def execute(self, engine, site, ctx):
+        self._announce(site, step=self.step)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+
+class _Kill9DuringSave(_Fault):
+    """SIGKILL mid-checkpoint-write: the save that must never corrupt
+    ``latest``. ``save_index`` picks which save of the run (1-based),
+    ``after_bytes`` how deep into the stream the kill lands."""
+
+    sites = ("ckpt_write",)
+    type_name = "kill9_during_save"
+
+    def __init__(self, spec):
+        super().__init__(spec)
+        self.save_index = int(spec.get("save_index", 1))
+        self.after_bytes = int(spec.get("after_bytes", 0))
+
+    def should_fire(self, engine, site, ctx):
+        return (
+            not self.fired
+            and engine.save_index == self.save_index
+            and ctx.get("written", 0) >= self.after_bytes
+        )
+
+    def execute(self, engine, site, ctx):
+        self._announce(site, save_index=self.save_index,
+                       written=ctx.get("written", 0))
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+class _CorruptCkptBytes(_Fault):
+    """Flip bytes of a just-committed checkpoint file in place (XOR 0xFF),
+    leaving its checksum sidecar stale — exactly the on-disk damage the
+    integrity pre-check + quarantine path exists for. ``offset`` None
+    means the middle of the file."""
+
+    sites = ("ckpt_commit",)
+    type_name = "corrupt_ckpt_bytes"
+
+    def __init__(self, spec):
+        super().__init__(spec)
+        self.save_index = spec.get("save_index")
+        self.offset = spec.get("offset")
+        self.count = int(spec.get("count", 64))
+
+    def should_fire(self, engine, site, ctx):
+        if self.fired:
+            return False
+        if self.save_index is not None and (
+            engine.save_index != int(self.save_index)
+        ):
+            return False
+        path = ctx.get("path")
+        # sharded commits are directories; this fault targets the vanilla
+        # single-file container
+        return bool(path) and os.path.isfile(path)
+
+    def execute(self, engine, site, ctx):
+        path = ctx["path"]
+        size = os.path.getsize(path)
+        offset = self.offset if self.offset is not None else size // 2
+        offset = max(0, min(int(offset), max(size - 1, 0)))
+        count = min(self.count, size - offset)
+        if count <= 0:
+            return
+        with open(path, "r+b") as f:
+            f.seek(offset)
+            data = f.read(count)
+            f.seek(offset)
+            f.write(bytes(b ^ 0xFF for b in data))
+        self._announce(site, path=str(path), offset=offset, count=count)
+
+
+class _TransientIOError(_Fault):
+    """EIO on checkpoint write/fsync/rename/read that heals after
+    ``fail_count`` raises — the retry/backoff path's proof load."""
+
+    sites = ("ckpt_write", "ckpt_fsync", "ckpt_rename", "ckpt_read")
+    type_name = "transient_io_error"
+    _OPS = {"write": "ckpt_write", "fsync": "ckpt_fsync",
+            "rename": "ckpt_rename", "read": "ckpt_read", "any": None}
+
+    def __init__(self, spec):
+        super().__init__(spec)
+        op = spec.get("op", "any")
+        if op not in self._OPS:
+            raise FaultPlanError(f"transient_io_error: unknown op {op!r}")
+        self.site_filter = self._OPS[op]
+        self.remaining = int(spec.get("fail_count", 1))
+
+    def should_fire(self, engine, site, ctx):
+        if self.remaining <= 0:
+            return False
+        if self.site_filter is not None and site != self.site_filter:
+            return False
+        self.remaining -= 1
+        return True
+
+    def execute(self, engine, site, ctx):
+        self._announce(site, path=str(ctx.get("path", "")),
+                       remaining=self.remaining)
+        raise _injected_os_error(f"transient_io_error at {site}")
+
+
+class _LoaderStall(_Fault):
+    """Block batch materialization for ``seconds`` — the hung-data-source
+    scenario the loader's stall watchdog must convert into a typed error
+    instead of a wedged step loop. ``batch`` picks which seam hit
+    (1-based); None means the first."""
+
+    sites = ("loader_batch",)
+    type_name = "loader_stall"
+
+    def __init__(self, spec):
+        super().__init__(spec)
+        self.seconds = float(spec.get("seconds", 5.0))
+        self.batch = spec.get("batch")
+
+    def should_fire(self, engine, site, ctx):
+        if self.fired:
+            return False
+        return self.batch is None or self.hits == int(self.batch)
+
+    def execute(self, engine, site, ctx):
+        self._announce(site, seconds=self.seconds, hit=self.hits)
+        time.sleep(self.seconds)
+
+
+class _MetadataFlap(_Fault):
+    """Fail the maintenance watcher's metadata polls: the first
+    ``after_ok`` seam hits pass (letting the watcher prove the server
+    healthy), then ``fail_count`` hits raise, then the endpoint heals —
+    the backoff/degrade/recover schedule's test load."""
+
+    sites = ("metadata_poll",)
+    type_name = "metadata_flap"
+
+    def __init__(self, spec):
+        super().__init__(spec)
+        self.after_ok = int(spec.get("after_ok", 1))
+        self.remaining = int(spec.get("fail_count", 3))
+
+    def should_fire(self, engine, site, ctx):
+        if self.hits <= self.after_ok or self.remaining <= 0:
+            return False
+        self.remaining -= 1
+        return True
+
+    def execute(self, engine, site, ctx):
+        self._announce(site, remaining=self.remaining)
+        raise _injected_os_error("metadata_flap")
+
+
+_FAULT_TYPES = {
+    cls.type_name: cls
+    for cls in (
+        _SigtermAtStep, _Kill9DuringSave, _CorruptCkptBytes,
+        _TransientIOError, _LoaderStall, _MetadataFlap,
+    )
+}
+
+
+class FaultEngine:
+    """The active plan: parsed fault list + the per-run save counter the
+    save-indexed faults key on. One engine per process; sites funnel
+    through ``check``."""
+
+    def __init__(self, plan):
+        if not isinstance(plan, dict):
+            raise FaultPlanError("fault plan must be a JSON object")
+        self.seed = int(plan.get("seed", 0))
+        self.save_index = 0
+        self._lock = threading.Lock()
+        self.faults = []
+        for spec in plan.get("faults", []):
+            ftype = spec.get("type")
+            cls = _FAULT_TYPES.get(ftype)
+            if cls is None:
+                raise FaultPlanError(
+                    f"unknown fault type {ftype!r}; known: "
+                    f"{sorted(_FAULT_TYPES)}"
+                )
+            try:
+                self.faults.append(cls(spec))
+            except (KeyError, TypeError, ValueError) as e:
+                raise FaultPlanError(f"bad {ftype} spec {spec}: {e}") from e
+
+    def check(self, site, **ctx):
+        if site == "ckpt_save_begin":
+            with self._lock:
+                self.save_index += 1
+        for f in self.faults:
+            if site in f.sites:
+                f.maybe_fire(self, site, ctx)  # locks internally
+
+
+def _noop(site, **ctx):
+    return None
+
+
+_bootstrap_lock = threading.Lock()
+
+
+def _bootstrap(site, **ctx):
+    """First seam hit of the process: resolve ``$PYRECOVER_FAULT_PLAN``
+    once, then rebind ``check`` so later hits pay nothing. Locked — the
+    loader's producer thread and the main thread can hit their first
+    seams concurrently, and two engines would double-fire every fault."""
+    global check
+    with _bootstrap_lock:
+        if check is _bootstrap:
+            plan = load_env_plan()
+            if plan is None:
+                check = _noop
+            else:
+                install(plan)
+    return check(site, **ctx)
+
+
+check = _bootstrap
+_engine = None
+
+
+def load_env_plan():
+    """Plan dict from ``$PYRECOVER_FAULT_PLAN`` (inline JSON if it starts
+    with ``{``, else a path to a JSON file), or None."""
+    raw = os.environ.get(PLAN_ENV, "").strip()
+    if not raw:
+        return None
+    if not raw.startswith("{"):
+        with open(raw) as f:
+            raw = f.read()
+    try:
+        return json.loads(raw)
+    except ValueError as e:
+        raise FaultPlanError(f"${PLAN_ENV} is not valid JSON: {e}") from e
+
+
+def install(plan):
+    """Activate a fault plan (dict or FaultEngine) process-wide. Returns
+    the engine. Seams go live immediately."""
+    global check, _engine
+    engine = plan if isinstance(plan, FaultEngine) else FaultEngine(plan)
+    _engine = engine
+    check = engine.check
+    return engine
+
+
+def clear():
+    """Deactivate fault injection; seams return to no-ops."""
+    global check, _engine
+    _engine = None
+    check = _noop
+
+
+def active():
+    """The installed FaultEngine, or None."""
+    return _engine

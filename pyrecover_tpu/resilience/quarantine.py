@@ -1,0 +1,86 @@
+"""Quarantine: atomically move failed checkpoints aside, never delete them.
+
+When the latest-resume fallback finds a checkpoint that fails its
+integrity pre-check (torn save, flipped bytes, missing commit marker), it
+used to *leave it in place* — so every subsequent restart re-discovered,
+re-checked, and re-skipped the same corpse, and a retention prune could
+count it against ``max_keep`` and delete a GOOD checkpoint to make room
+for a bad one. Quarantine moves the failed entry (file + checksum
+sidecars, or the whole sharded directory) into ``<exp_dir>/.corrupt/``:
+
+  * the move is a same-filesystem ``os.replace`` — atomic, no copy;
+  * ``registry.list_checkpoints`` never descends into ``.corrupt/`` and
+    pruning never counts or deletes quarantined entries, so the evidence
+    survives for post-mortem (``tools/inspect_checkpoint.py`` still reads
+    it);
+  * name collisions (the same step quarantined twice across restarts) get
+    a numeric suffix instead of overwriting the earlier corpse.
+
+Quarantine must never turn a recoverable resume into a crash: every
+failure here degrades to a warning and the caller's fallback walk
+continues with the file left in place.
+"""
+
+import os
+from pathlib import Path
+
+from pyrecover_tpu import telemetry
+from pyrecover_tpu.utils.logging import log_host0
+
+QUARANTINE_DIRNAME = ".corrupt"
+
+_SIDECAR_SUFFIXES = (".sha256", ".md5")
+
+
+def quarantine_dir(exp_dir):
+    return Path(exp_dir) / QUARANTINE_DIRNAME
+
+
+def list_quarantined(exp_dir):
+    """Quarantined checkpoint paths (newest-suffix last), [] if none."""
+    q = quarantine_dir(exp_dir)
+    if not q.is_dir():
+        return []
+    return sorted(p for p in q.iterdir() if not p.name.endswith(_SIDECAR_SUFFIXES))
+
+
+def quarantine_checkpoint(path, reason=""):
+    """Move a failed checkpoint into ``.corrupt/`` next to it.
+
+    Handles both engines: a vanilla ``.ckpt`` file moves with its checksum
+    sidecars; a sharded checkpoint directory moves whole. Returns the
+    destination Path, or None when nothing was moved (missing source or a
+    filesystem refusal — logged, never raised).
+    """
+    path = Path(path)
+    if not path.exists():
+        return None
+    qdir = path.parent / QUARANTINE_DIRNAME
+    try:
+        qdir.mkdir(exist_ok=True)
+        dest = qdir / path.name
+        n = 0
+        while dest.exists():
+            n += 1
+            dest = qdir / f"{path.name}.{n}"
+        os.replace(path, dest)
+        if not dest.is_dir():  # vanilla file: bring its checksum sidecars
+            for suffix in _SIDECAR_SUFFIXES:
+                side = path.with_suffix(path.suffix + suffix)
+                if side.exists():
+                    os.replace(side, qdir / (dest.name + suffix))
+    except OSError as e:
+        log_host0(
+            "could not quarantine checkpoint %s (%s: %s); leaving it in "
+            "place", path, type(e).__name__, e, level=30,  # WARNING
+        )
+        return None
+    log_host0(
+        "Quarantined checkpoint %s -> %s%s", path.name,
+        f"{QUARANTINE_DIRNAME}/{dest.name}",
+        f" ({reason})" if reason else "", level=30,  # WARNING
+    )
+    telemetry.emit(
+        "ckpt_quarantined", path=str(path), dest=str(dest), reason=reason,
+    )
+    return dest

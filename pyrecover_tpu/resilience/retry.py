@@ -1,0 +1,66 @@
+"""Transient-I/O retry: capped exponential backoff + deterministic jitter.
+
+Checkpoint durability must survive the filesystem having a bad second —
+an NFS/GCS-fuse blip mid-save (EIO/EAGAIN on write, fsync, or the atomic
+publish rename) should cost a retry, not the checkpoint. Every retry is
+visible as a ``ckpt_io_retry`` telemetry event, so a quietly degrading
+filesystem shows up in the event stream long before it kills a save.
+
+Permanent errors (ENOSPC, EACCES, ENOENT, ...) are NOT retried: backoff
+cannot conjure disk space, and masking them would only delay the failure
+past the point where the operator can still act inside the preemption
+grace window.
+"""
+
+import errno
+import os
+import random
+import time
+
+from pyrecover_tpu import telemetry
+
+DEFAULT_ATTEMPTS = 5
+ATTEMPTS_ENV = "PYRECOVER_IO_RETRIES"
+
+# errnos worth sleeping on: the operation can genuinely succeed on retry
+TRANSIENT_ERRNOS = frozenset({
+    errno.EIO, errno.EAGAIN, errno.EINTR, errno.EBUSY, errno.ETIMEDOUT,
+})
+
+# deterministic jitter stream: retries de-synchronize across hosts hashing
+# the process id in, while one process replays the same schedule every run
+_jitter = random.Random(0x5EED ^ os.getpid())
+
+
+def is_transient(exc):
+    """True when the OSError is worth retrying."""
+    return isinstance(exc, OSError) and exc.errno in TRANSIENT_ERRNOS
+
+
+def io_retry(fn, *, op, path="", attempts=None, base_delay_s=0.05,
+             max_delay_s=2.0, sleep=time.sleep):
+    """Run ``fn()``; on a transient OSError, back off and retry.
+
+    Backoff doubles from ``base_delay_s`` capped at ``max_delay_s``, each
+    delay scaled by a jitter factor in [0.5, 1.5). ``attempts`` is the
+    TOTAL number of tries (default ``$PYRECOVER_IO_RETRIES`` or 5); the
+    final failure re-raises the original error. Non-transient errors and
+    non-OSErrors propagate immediately.
+    """
+    if attempts is None:
+        attempts = int(os.environ.get(ATTEMPTS_ENV, DEFAULT_ATTEMPTS))
+    attempts = max(1, attempts)
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn()
+        except OSError as e:
+            if attempt >= attempts or not is_transient(e):
+                raise
+            delay = min(base_delay_s * (2.0 ** (attempt - 1)), max_delay_s)
+            delay *= 0.5 + _jitter.random()
+            telemetry.emit(
+                "ckpt_io_retry", op=op, path=str(path), attempt=attempt,
+                attempts=attempts, errno=e.errno,
+                error=f"{type(e).__name__}: {e}", delay_s=round(delay, 4),
+            )
+            sleep(delay)

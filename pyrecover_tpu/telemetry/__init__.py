@@ -23,12 +23,19 @@ Core event names across the stack (fields beyond the envelope):
     ckpt_save_durable engine, wait_s
     ckpt_restore_start/ckpt_restore_done  engine, path, seconds
     ckpt_precheck_failed / ckpt_restore_fallback  path, reason
+    ckpt_io_retry     op, path, attempt, errno, delay_s (transient-IO retry)
+    ckpt_quarantined  path, dest, reason (moved into .corrupt/, never pruned)
     ckpt_prune        engine, count, removed
+    ckpt_pruned       engine, path, step (one per retention removal)
     resume            path, step, seconds; resume_replay: replayed_steps
     preempt_check     step, time_left_s, threshold_s
     preempt_notice / preempt_stop / preempt_estimate
+    preempt_signal_escalation  signal, count, step (2nd signal mid-save)
     maintenance_event / maintenance_watcher_retired / maintenance_degraded
+    maintenance_recovered / maintenance_watcher_hang  (flap + wedge drill)
     data_stall        wait_s, depth, batch
+    loader_stall_timeout  wait_s, timeout_s, batch (stall watchdog tripped)
+    fault_injected    type, site, ... (resilience.faults fired an injection)
     mfu_peak_unknown  device_kind, fallback_flops
     spec_axis_dropped axis, mesh_axes (a sharding spec named a missing axis)
     ckpt_manifest_dtype_drift  path, detail (resume will cast the leaf)

@@ -37,6 +37,7 @@ from pyrecover_tpu.preempt import (
     read_requeue_marker,
     write_requeue_marker,
 )
+from pyrecover_tpu.resilience import faults, quarantine_checkpoint
 from pyrecover_tpu.train_state import (
     create_train_state,
     make_eval_step,
@@ -202,6 +203,7 @@ def build_eval_runner(config, model_config, pad_token_id, mesh):
     loader = DataLoader(
         eval_ds, sampler, pad_token_id=pad_token_id, mesh=mesh,
         prefetch=2, num_workers=2,
+        stall_timeout=config.loader_stall_timeout,
     )
 
     def run_eval(state):  # jaxlint: hot-loop
@@ -295,6 +297,13 @@ def _resume(config, exp_dir, state, sampler, sharded_ckptr, totals):  # jaxlint:
                 telemetry.emit(
                     "ckpt_precheck_failed", path=str(cand), reason=reason
                 )
+                # move the corpse into .corrupt/ (host 0; atomic rename):
+                # the next restart must not re-discover and re-skip it,
+                # and retention must never count it against max_keep. The
+                # fallback verdict was already broadcast, so every host
+                # agrees this candidate is dead before the move happens.
+                if jax.process_index() == 0:
+                    quarantine_checkpoint(cand, reason=reason)
                 continue
             prechecked = True
         try:
@@ -330,6 +339,11 @@ def _resume(config, exp_dir, state, sampler, sharded_ckptr, totals):  # jaxlint:
             telemetry.emit(
                 "ckpt_restore_fallback", path=str(cand),
                 reason=f"{type(e).__name__}: {e}",
+            )
+            # tensor-data damage the cheap precheck couldn't see: same
+            # quarantine protocol (single-process only reaches here)
+            quarantine_checkpoint(
+                cand, reason=f"{type(e).__name__}: {e}"
             )
             continue
         start_step = int(meta.get("step", int(np.asarray(state.step))))
@@ -487,29 +501,40 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
         state_to_save = dataclasses.replace(state, epoch=epoch)
         sampler_meta = {"consumed": int(step), **sampler.state_dict()}
         extra = {"step": int(step), "epoch": sampler_epoch_of(step)}
-        if config.sharded_checkpoint:
-            secs = sharded_ckptr.save(
-                path, state_to_save, sampler_meta,
-                max_keep=config.max_kept_checkpoints, extra_meta=extra,
-            )
-            if final:
-                sharded_ckptr.wait()
-        else:
-            join_pending_saves()  # serialize with any in-flight write
-            if config.async_checkpoint and not final:
-                secs, handle = save_ckpt_vanilla(
+        # while the save is in flight a FIRST signal defers exit until the
+        # commit completes (the normal deferred-exit path); a SECOND one
+        # escalates to an immediate requeue marker + exit — the scheduler
+        # has stopped waiting, so must we
+        if watcher is not None:
+            watcher.arm_escalation(exp_dir, step)
+        try:
+            if config.sharded_checkpoint:
+                secs = sharded_ckptr.save(
                     path, state_to_save, sampler_meta,
-                    verify=config.verify_checkpoints,
                     max_keep=config.max_kept_checkpoints, extra_meta=extra,
-                    background=True,
                 )
-                pending_vanilla.append(handle)
+                if final:
+                    sharded_ckptr.wait()
             else:
-                secs = save_ckpt_vanilla(
-                    path, state_to_save, sampler_meta,
-                    verify=config.verify_checkpoints,
-                    max_keep=config.max_kept_checkpoints, extra_meta=extra,
-                )
+                join_pending_saves()  # serialize with any in-flight write
+                if config.async_checkpoint and not final:
+                    secs, handle = save_ckpt_vanilla(
+                        path, state_to_save, sampler_meta,
+                        verify=config.verify_checkpoints,
+                        max_keep=config.max_kept_checkpoints,
+                        extra_meta=extra, background=True,
+                    )
+                    pending_vanilla.append(handle)
+                else:
+                    secs = save_ckpt_vanilla(
+                        path, state_to_save, sampler_meta,
+                        verify=config.verify_checkpoints,
+                        max_keep=config.max_kept_checkpoints,
+                        extra_meta=extra,
+                    )
+        finally:
+            if watcher is not None:
+                watcher.disarm_escalation()
         log_host0("Saved checkpoint %s in %.2f s", path.name, secs)
         telemetry.emit(
             "ckpt_saved", step=int(step), path=path.name, final=bool(final),
@@ -547,6 +572,7 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
     loader = DataLoader(
         dataset, sampler, pad_token_id=pad_token_id, mesh=mesh,
         prefetch=2, num_workers=4,
+        stall_timeout=config.loader_stall_timeout,
     ).start()
 
     # everything past loader.start() runs under try/finally: an exception
@@ -652,6 +678,9 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
                     jax.profiler.start_trace(config.profile_dir)
                     profiling = True
 
+                # fault seam: `sigterm_at_step N` delivers its signal as
+                # step N begins, so the final checkpoint lands exactly at N
+                faults.check("train_step", step=step + 1)
                 iter_t0 = time.monotonic()
                 epoch, batch = next(loader)
                 t_data = time.monotonic()

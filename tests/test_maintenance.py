@@ -26,6 +26,7 @@ class FakeMetadataServer:
         self.maintenance_value = "NONE"
         self.preempted = "FALSE"
         self.etag = "aaaa"
+        self.hold_s = 0.0  # wedge: sleep this long before every reply
         self._changed = threading.Event()
         self.requests_seen = []
 
@@ -39,6 +40,8 @@ class FakeMetadataServer:
                 parsed = urlparse(self.path)
                 q = parse_qs(parsed.query)
                 fake.requests_seen.append(parsed.path)
+                if fake.hold_s:
+                    time.sleep(fake.hold_s)
                 if self.headers.get("Metadata-Flavor") != "Google":
                     self.send_error(403, "Missing Metadata-Flavor header")
                     return
@@ -151,6 +154,101 @@ def test_migrate_event_is_actionable(fake_metadata):
     ).start()
     fake_metadata.announce_maintenance("MIGRATE_ON_HOST_MAINTENANCE")
     assert _wait_for(lambda: fired)
+
+
+def test_metadata_flap_backoff_degrade_recover(fake_metadata):
+    """The `metadata_flap` fault drill: a healthy watcher hit by a burst of
+    poll failures must (1) back off on the documented capped-exponential
+    schedule, (2) cross into degraded (deadline-only) mode at
+    max_consecutive_errors with a `maintenance_degraded` event — NOT
+    retire — and (3) recover with a `maintenance_recovered` event when the
+    endpoint heals, after which a real announcement still fires."""
+    from pyrecover_tpu import telemetry
+    from pyrecover_tpu.resilience import faults
+
+    sink = telemetry.add_sink(telemetry.MemorySink())
+    faults.install({"faults": [
+        # 2 healthy polls prove the server lives, then 4 failures, heal
+        {"type": "metadata_flap", "after_ok": 2, "fail_count": 4},
+    ]})
+    w = MaintenanceEventWatcher(
+        base=fake_metadata.base, poll_timeout_s=0.2,
+        max_consecutive_errors=3, backoff_base_s=0.02,
+    )
+    try:
+        w.start()
+        assert _wait_for(lambda: len(w.backoff_history) >= 4)
+        # capped exponential: base·2^k with ceiling poll_timeout_s
+        assert w.backoff_history[:4] == pytest.approx(
+            [0.02, 0.04, 0.08, 0.16]
+        )
+        assert all(d <= 0.2 for d in w.backoff_history)
+        # degraded exactly at the threshold, and the thread did NOT retire
+        assert _wait_for(
+            lambda: any(e["event"] == "maintenance_degraded"
+                        for e in sink.events)
+        )
+        assert w.alive
+        # endpoint healed (flap exhausted): recovery is announced
+        assert _wait_for(
+            lambda: any(e["event"] == "maintenance_recovered"
+                        for e in sink.events)
+        )
+        assert not w.degraded
+        # detection is whole again: a real announcement still fires
+        fake_metadata.announce_maintenance()
+        assert _wait_for(lambda: w.event_seen is not None)
+    finally:
+        w.stop()
+        faults.clear()
+        telemetry.remove_sink(sink)
+
+
+def test_metadata_flap_from_the_start_still_retires():
+    """A flap covering the FIRST polls is indistinguishable from not being
+    on GCE: the never-ok retire path must still win (no thread left
+    spinning against a server that never answered)."""
+    from pyrecover_tpu.resilience import faults
+
+    faults.install({"faults": [
+        {"type": "metadata_flap", "after_ok": 0, "fail_count": 10},
+    ]})
+    w = MaintenanceEventWatcher(
+        base="http://127.0.0.1:1/computeMetadata/v1",
+        poll_timeout_s=0.2, max_consecutive_errors=2, backoff_base_s=0.01,
+    ).start()
+    try:
+        assert _wait_for(lambda: not w.alive, timeout=10)
+        assert w.event_seen is None and not w.degraded
+    finally:
+        faults.clear()
+
+
+def test_hung_metadata_request_emits_hang_event(fake_metadata):
+    """A server that accepts but never answers (socket timeout burns the
+    whole request budget) is a HANG, not a refusal — the watcher must say
+    so (`maintenance_watcher_hang`) while degrading gracefully."""
+    from pyrecover_tpu import telemetry
+
+    sink = telemetry.add_sink(telemetry.MemorySink())
+    # wedge the fake server: every reply now sleeps past the client timeout
+    fake_metadata.hold_s = 1.0
+    w = MaintenanceEventWatcher(
+        base=fake_metadata.base, poll_timeout_s=0.2,
+        max_consecutive_errors=5, backoff_base_s=0.01, read_timeout_s=0.3,
+    ).start()
+    try:
+        assert _wait_for(
+            lambda: any(e["event"] == "maintenance_watcher_hang"
+                        for e in sink.events), timeout=15,
+        )
+        hang = [e for e in sink.events
+                if e["event"] == "maintenance_watcher_hang"][0]
+        assert hang["seconds"] >= 0.3 * 0.999
+    finally:
+        w.stop()
+        fake_metadata.hold_s = 0.0
+        telemetry.remove_sink(sink)
 
 
 def test_watcher_retires_off_gce():

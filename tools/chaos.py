@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""chaos CLI — kill/corrupt/resume soak harness for the recovery stack.
+
+Usage:
+    python tools/chaos.py --preset smoke --seed 0
+    python tools/chaos.py --preset soak --workdir /tmp/soak --json report.json
+
+All logic lives in ``pyrecover_tpu.resilience.chaos`` (fault plans in
+``resilience.faults``); this file is the executable shim so the harness is
+runnable before the package is installed.
+"""
+
+import sys
+from pathlib import Path
+
+# runnable from any cwd, installed or not
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from pyrecover_tpu.resilience.chaos import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
