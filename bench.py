@@ -205,6 +205,8 @@ def main():
         # "steps" timed at 3ms each); pulling a scalar to the host cannot.
         return float(jnp.sum(state.params["final_norm"].astype(jnp.float32)))
 
+    from pyrecover_tpu import telemetry
+
     with jax.sharding.set_mesh(mesh):
         # warmup (compile)
         for _ in range(args.warmup):
@@ -212,13 +214,34 @@ def main():
             state, metrics = step_fn(state, batch)
         sync(state)
 
+        # per-step wall times feed the telemetry metrics histogram so the
+        # BENCH JSON carries the same metrics_snapshot-derived p50/p95/p99
+        # a real run's telemetry stream reports (under async dispatch these
+        # are enqueue+backpressure times; the final sync bounds the total)
+        bench_sink = telemetry.add_sink(telemetry.MemorySink())
+        step_hist = telemetry.metrics.histogram("bench_step_time_s")
         t0 = time.monotonic()
+        t_prev = t0
         for _ in range(args.steps):
             _, batch = next(loader)
             state, metrics = step_fn(state, batch)
+            t_now = time.monotonic()
+            # jaxlint: disable-next=untimed-device-work -- per-step enqueue
+            # time is the point here; the distribution's tail shows queue
+            # backpressure, and the synced total below bounds the truth
+            step_hist.observe(t_now - t_prev)
+            t_prev = t_now
         sync(state)
         dt = time.monotonic() - t0
     loader.stop()
+
+    telemetry.metrics.flush(reason="bench")
+    snap = next(
+        (e for e in reversed(bench_sink.events)
+         if e["event"] == "metrics_snapshot"), {},
+    )
+    step_pct = (snap.get("hists") or {}).get("bench_step_time_s") or {}
+    telemetry.remove_sink(bench_sink)
 
     tokens = args.steps * args.batch_size * args.seq_len
     tok_per_sec = tokens / dt
@@ -251,6 +274,11 @@ def main():
         "seq_len": args.seq_len,
         "batch_size": args.batch_size,
         "step_time_s": round(dt / args.steps, 4),
+        # metrics_snapshot-derived distribution (telemetry/metrics.py
+        # log-bucketed histogram; dispatch-side times, see note above)
+        "step_time_p50_s": step_pct.get("p50"),
+        "step_time_p95_s": step_pct.get("p95"),
+        "step_time_p99_s": step_pct.get("p99"),
         "mfu_pct": round(mfu * 100, 2),
         "mfu_convention": "6N excludes token embedding (ref train.py:126-127)",
         "tflops_per_chip": round(flop_per_token * tok_per_sec_chip / 1e12, 2),

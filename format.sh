@@ -65,12 +65,47 @@ fi
 # bit-exact against an uninterrupted golden run, exactly the injected
 # corruption quarantined, and the ckpt_io_retry/ckpt_quarantined telemetry
 # trail present. JSON report at CHAOS_JSON, beside the other gate reports.
+# The workdir is kept (and pre-cleaned) so the traceview smoke below can
+# merge the telemetry shards the soak just produced.
+CHAOS_WORK="${CHAOS_WORK:-/tmp/pyrecover_chaos_smoke}"
+rm -rf "$CHAOS_WORK"
 if CHAOS_OUT=$(JAX_PLATFORMS=cpu python tools/chaos.py \
-    --preset smoke --seed 0 \
+    --preset smoke --seed 0 --workdir "$CHAOS_WORK" \
     --json "${CHAOS_JSON:-/tmp/chaos_report.json}" 2>&1); then
   echo "$CHAOS_OUT" | tail -1        # clean: one OK line
 else
   echo "$CHAOS_OUT"                  # violations: full cycle report
+  rc=1
+fi
+
+# traceview smoke: the tracing stack's gate (pyrecover_tpu/telemetry).
+# Merges the chaos soak's telemetry shards (the interrupted run + the
+# golden run — rotation-split JSONL included), exports Chrome-trace-event
+# JSON, and fails unless the trace is valid (loads as JSON, has span
+# slices) and the analysis report is non-empty. Trace at TRACEVIEW_TRACE
+# (open in https://ui.perfetto.dev), report JSON beside the other gates.
+TRACEVIEW_TRACE="${TRACEVIEW_TRACE:-/tmp/traceview_trace.json}"
+if TV_OUT=$(JAX_PLATFORMS=cpu python tools/traceview.py \
+    "$CHAOS_WORK"/chaos/chaos_telemetry.jsonl \
+    "$CHAOS_WORK"/golden/golden_telemetry.jsonl \
+    --out "$TRACEVIEW_TRACE" \
+    --report-json "${TRACEVIEW_JSON:-/tmp/traceview_report.json}" 2>&1); then
+  if [ -z "$TV_OUT" ]; then
+    echo "traceview: empty analysis report"; rc=1
+  else
+    echo "$TV_OUT" | head -3
+  fi
+  python - "$TRACEVIEW_TRACE" <<'PYEOF' || rc=1
+import json, sys
+trace = json.load(open(sys.argv[1]))
+spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+assert spans, "trace exported no span slices"
+assert all(e["dur"] >= 0 and e["ts"] >= 0 for e in spans)
+print(f"traceview: OK — {len(trace['traceEvents'])} trace events, "
+      f"{len(spans)} span slices")
+PYEOF
+else
+  echo "$TV_OUT"
   rc=1
 fi
 

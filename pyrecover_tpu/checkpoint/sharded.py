@@ -62,14 +62,21 @@ class ShardedCheckpointer:
         }
         if extra_meta:
             meta.update(extra_meta)
-        self._ckptr.save(
-            path,
-            args=ocp.args.Composite(
-                state=ocp.args.PyTreeSave(state),
-                meta=ocp.args.JsonSave(meta),
-            ),
-            force=True,
-        )
+        # async saves: this span covers serialize + the device→host copy
+        # (the part the training loop pays for); the write-to-durable tail
+        # shows up as the ckpt_wait_durable span when someone waits
+        with telemetry.span(
+            "ckpt_serialize", engine="sharded", path=str(path),
+            async_=self.use_async, metric="ckpt_sharded_serialize_s",
+        ):
+            self._ckptr.save(
+                path,
+                args=ocp.args.Composite(
+                    state=ocp.args.PyTreeSave(state),
+                    meta=ocp.args.JsonSave(meta),
+                ),
+                force=True,
+            )
         # async saves: dispatch accepted (durability is wait()'s business);
         # sync saves: the directory is committed at this point
         faults.check("ckpt_commit", engine="sharded", path=str(path))
@@ -89,7 +96,11 @@ class ShardedCheckpointer:
         """Block until any in-flight async save is durable."""
         if hasattr(self._ckptr, "wait_until_finished"):
             t0 = time.monotonic()
-            self._ckptr.wait_until_finished()
+            with telemetry.span(
+                "ckpt_wait_durable", engine="sharded",
+                metric="ckpt_sharded_durable_wait_s",
+            ):
+                self._ckptr.wait_until_finished()
             # background seconds the training loop did NOT pay for: the gap
             # between dispatch (blocking_s) and durability shows up here
             # only when someone waits — final saves and shutdown
@@ -104,15 +115,19 @@ class ShardedCheckpointer:
         t0 = time.monotonic()
         telemetry.emit("ckpt_restore_start", engine="sharded", path=str(path))
         restore_args = ocp.checkpoint_utils.construct_restore_args(target_state)
-        result = self._ckptr.restore(
-            path,
-            args=ocp.args.Composite(
-                state=ocp.args.PyTreeRestore(
-                    item=target_state, restore_args=restore_args
+        with telemetry.span(
+            "ckpt_restore", engine="sharded", path=str(path),
+            metric="ckpt_sharded_restore_s",
+        ):
+            result = self._ckptr.restore(
+                path,
+                args=ocp.args.Composite(
+                    state=ocp.args.PyTreeRestore(
+                        item=target_state, restore_args=restore_args
+                    ),
+                    meta=ocp.args.JsonRestore(),
                 ),
-                meta=ocp.args.JsonRestore(),
-            ),
-        )
+            )
         meta = result.meta or {}
         telemetry.emit(
             "ckpt_restore_done", engine="sharded", path=str(path),

@@ -251,10 +251,13 @@ def save_ckpt_vanilla(path, state, sampler_state=None, *, verify=False,
         # gather NOW (collectives stay on the calling thread); only host 0
         # keeps the numpy copies, and the writer frees each one as written
         np_leaves = []
-        for _, x in path_leaves:
-            arr = _leaf_to_numpy(x)
-            np_leaves.append(arr if is_host0 else None)
-            del arr
+        with telemetry.span(
+            "ckpt_gather", engine="vanilla", metric="ckpt_vanilla_gather_s"
+        ):
+            for _, x in path_leaves:
+                arr = _leaf_to_numpy(x)
+                np_leaves.append(arr if is_host0 else None)
+                del arr
         handle = VanillaSaveHandle()
         if is_host0:
 
@@ -342,36 +345,49 @@ def _write_stream(path, leaves_iter, meta, verify, max_keep):
                 faults.check("ckpt_fsync", path=path_s)
                 os.fsync(f.fileno())
 
-            w(MAGIC)
-            w(len(meta_b).to_bytes(8, "little"))
-            w(meta_b)
-            for arr in leaves_iter:
-                data = memoryview(
-                    np.ascontiguousarray(arr).view(np.uint8)
-                ).cast("B")
-                del arr
-                w(len(data).to_bytes(8, "little"))
-                for off in range(0, len(data), _HASH_CHUNK):
-                    w(data[off : off + _HASH_CHUNK])
-                del data
+            with telemetry.span(
+                "ckpt_write", engine="vanilla", path=path_s,
+                metric="ckpt_vanilla_write_s",
+            ):
+                w(MAGIC)
+                w(len(meta_b).to_bytes(8, "little"))
+                w(meta_b)
+                for arr in leaves_iter:
+                    data = memoryview(
+                        np.ascontiguousarray(arr).view(np.uint8)
+                    ).cast("B")
+                    del arr
+                    w(len(data).to_bytes(8, "little"))
+                    for off in range(0, len(data), _HASH_CHUNK):
+                        w(data[off : off + _HASH_CHUNK])
+                    del data
             # durability BEFORE the atomic publish: a power cut after the
             # rename must not leave `latest` pointing at unsynced pages
-            f.flush()
-            io_retry(_fsync_once, op="fsync", path=path_s)
+            with telemetry.span(
+                "ckpt_fsync", engine="vanilla", metric="ckpt_vanilla_fsync_s"
+            ):
+                f.flush()
+                io_retry(_fsync_once, op="fsync", path=path_s)
 
         def _rename_once():
             faults.check("ckpt_rename", path=path_s)
             os.replace(tmp, path)  # atomic publish
 
-        io_retry(_rename_once, op="rename", path=path_s)
+        with telemetry.span(
+            "ckpt_rename", engine="vanilla", metric="ckpt_vanilla_commit_s"
+        ):
+            io_retry(_rename_once, op="rename", path=path_s)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
     if verify:
-        io_retry(
-            lambda: _sidecar(path).write_text(checksum.result()),
-            op="sidecar", path=path_s,
-        )
+        with telemetry.span(
+            "ckpt_sidecar", engine="vanilla", metric="ckpt_vanilla_sidecar_s"
+        ):
+            io_retry(
+                lambda: _sidecar(path).write_text(checksum.result()),
+                op="sidecar", path=path_s,
+            )
     faults.check("ckpt_commit", engine="vanilla", path=path_s)
     telemetry.emit(
         "ckpt_commit", engine="vanilla", path=str(path), bytes=written,
@@ -644,7 +660,11 @@ def load_ckpt_vanilla(path, target_state, *, verify=False):
         verify_thread = threading.Thread(target=_verify, daemon=True)
         verify_thread.start()
 
-    meta, _, np_leaves = read_ckpt_raw(path)
+    with telemetry.span(
+        "ckpt_read", engine="vanilla", path=str(path),
+        metric="ckpt_vanilla_read_s",
+    ):
+        meta, _, np_leaves = read_ckpt_raw(path)
 
     leaves, treedef = jax.tree_util.tree_flatten(target_state)
     if meta["num_leaves"] != len(leaves):
@@ -652,21 +672,29 @@ def load_ckpt_vanilla(path, target_state, *, verify=False):
             f"Checkpoint has {meta['num_leaves']} leaves, target expects {len(leaves)}"
         )
 
-    restored = []
-    for tgt, src in zip(leaves, np_leaves):
-        if tuple(tgt.shape) != tuple(src.shape):
-            raise CheckpointStructureError(
-                f"Shape mismatch on restore: checkpoint {src.shape} vs target {tgt.shape}"
-            )
-        src = src.astype(tgt.dtype)
-        if isinstance(tgt, jax.Array) and hasattr(tgt, "sharding"):
-            restored.append(jax.device_put(src, tgt.sharding))
-        else:
-            restored.append(jax.numpy.asarray(src))
-    state = jax.tree_util.tree_unflatten(treedef, restored)
+    with telemetry.span(
+        "ckpt_device_put", engine="vanilla",
+        metric="ckpt_vanilla_device_put_s",
+    ):
+        restored = []
+        for tgt, src in zip(leaves, np_leaves):
+            if tuple(tgt.shape) != tuple(src.shape):
+                raise CheckpointStructureError(
+                    f"Shape mismatch on restore: checkpoint {src.shape} vs target {tgt.shape}"
+                )
+            src = src.astype(tgt.dtype)
+            if isinstance(tgt, jax.Array) and hasattr(tgt, "sharding"):
+                restored.append(jax.device_put(src, tgt.sharding))
+            else:
+                restored.append(jax.numpy.asarray(src))
+        state = jax.tree_util.tree_unflatten(treedef, restored)
 
     if verify_thread is not None:
-        verify_thread.join()
+        with telemetry.span(
+            "ckpt_verify_wait", engine="vanilla",
+            metric="ckpt_vanilla_verify_s",
+        ):
+            verify_thread.join()
         if verify_error:
             raise ValueError(verify_error[0])
         log_host0("Checkpoint checksum verified: %s", path)

@@ -105,6 +105,9 @@ class TrainConfig:
     telemetry: bool = False
     telemetry_path: str = ""  # "" → <ckpt_dir>/<exp>/<exp>_telemetry.jsonl
     telemetry_stdout: bool = False  # mirror events into the host-0 text log
+    # seconds between metrics_snapshot flushes (counters/gauges/histogram
+    # percentiles from telemetry/metrics.py); flushed at sync points only
+    metrics_flush_interval_s: float = 30.0
     profile: bool = False
     profile_step_start: int = 10
     profile_step_end: int = 12
@@ -301,6 +304,11 @@ def build_parser():
                         "<checkpoint-dir>/<experiment>/<experiment>_telemetry.jsonl.")
     p.add_argument("--telemetry-stdout", action="store_true",
                    help="Also mirror telemetry events into the host-0 log.")
+    p.add_argument("--metrics-flush-interval", type=float,
+                   dest="metrics_flush_interval_s",
+                   default=d.metrics_flush_interval_s,
+                   help="Seconds between metrics_snapshot telemetry events "
+                        "(step-time/loader/ckpt-phase percentiles).")
     p.add_argument("--profile", action="store_true")
     p.add_argument("--profile-step-start", type=int, default=d.profile_step_start)
     p.add_argument("--profile-step-end", type=int, default=d.profile_step_end)
@@ -375,6 +383,7 @@ def get_args(argv=None):
         telemetry=ns.telemetry,
         telemetry_path=ns.telemetry_path,
         telemetry_stdout=ns.telemetry_stdout,
+        metrics_flush_interval_s=ns.metrics_flush_interval_s,
         profile=ns.profile,
         profile_step_start=ns.profile_step_start,
         profile_step_end=ns.profile_step_end,

@@ -57,9 +57,15 @@ class DataLoader:
         self.batches_served = 0
         self.stall_count = 0
         self.stall_s = 0.0
+        self._wait_hist = None  # lazily bound loader_wait_s histogram
         self._sharding = (
             NamedSharding(mesh, batch_pspec()) if mesh is not None else None
         )
+
+    def _observe_wait(self, waited):  # jaxlint: host-only
+        if self._wait_hist is None:
+            self._wait_hist = telemetry.metrics.histogram("loader_wait_s")
+        self._wait_hist.observe(waited)
 
     # -- host slice of the global index batch --------------------------------
     def _local_indices(self, global_indices):
@@ -143,6 +149,10 @@ class DataLoader:
                 self.start()
             try:
                 item = self._queue.get_nowait()
+                if telemetry.enabled():
+                    # queue hit: the wait histogram records an exact zero,
+                    # so p50=0 with a stall tail is readable at a glance
+                    self._observe_wait(0.0)
             except queue.Empty:
                 # the prefetch queue ran dry: the consumer (the train loop)
                 # is now stalled on host-side tokenize/collate — the exact
@@ -158,6 +168,11 @@ class DataLoader:
                     waited = time.monotonic() - t0
                     self.stall_count += 1
                     self.stall_s += waited
+                    telemetry.record_span(
+                        "loader_wait", t0, t0 + waited, timeout=True,
+                        batch=self.batches_served + 1,
+                        metric="loader_wait_s",
+                    )
                     telemetry.emit(
                         "loader_stall_timeout", wait_s=round(waited, 3),
                         timeout_s=self.stall_timeout,
@@ -171,6 +186,14 @@ class DataLoader:
                 waited = time.monotonic() - t0
                 self.stall_count += 1
                 self.stall_s += waited
+                # the wait is a trace slice AND a histogram sample: the
+                # trace shows WHICH batch stalled, the percentiles show
+                # how often (span written after the fact — the wait
+                # itself never pays the event I/O)
+                telemetry.record_span(
+                    "loader_wait", t0, t0 + waited,
+                    batch=self.batches_served + 1, metric="loader_wait_s",
+                )
                 if waited >= _STALL_EVENT_THRESHOLD_S:
                     telemetry.emit(
                         "data_stall", wait_s=round(waited, 6),

@@ -180,9 +180,13 @@ class MaintenanceEventWatcher:
                 faults.check("metadata_poll", base=self.base)
                 # preempted is a plain read (no etag churn): spot/queued-
                 # resource reclaims flip it without a maintenance-event
-                val, _ = self._get(
-                    "instance/preempted", timeout=self.read_timeout_s
-                )
+                with telemetry.span(
+                    "metadata_poll", endpoint="preempted",
+                    metric="metadata_poll_s",
+                ):
+                    val, _ = self._get(
+                        "instance/preempted", timeout=self.read_timeout_s
+                    )
                 errors = 0  # any successful request proves the server lives
                 ever_ok = True
                 self._recovered()
@@ -193,16 +197,21 @@ class MaintenanceEventWatcher:
                 # etag) returns immediately with the current value+etag
                 t_req = time.monotonic()
                 req_timeout = self.poll_timeout_s + 30
-                val, etag = self._get(
-                    "instance/maintenance-event", etag=etag,
-                    timeout=req_timeout,
-                )
+                with telemetry.span(
+                    "metadata_poll", endpoint="maintenance-event",
+                    metric="metadata_poll_s",
+                ):
+                    val, etag = self._get(
+                        "instance/maintenance-event", etag=etag,
+                        timeout=req_timeout,
+                    )
                 errors = 0
                 if val.upper() in _ACTIONABLE:
                     self._fire(f"instance/maintenance-event={val}")
                     return
             except (urllib.error.URLError, OSError, ValueError):
                 errors += 1
+                telemetry.metrics.counter("metadata_poll_errors").inc()
                 hang_after = (
                     self.hang_timeout_s
                     if self.hang_timeout_s is not None else req_timeout

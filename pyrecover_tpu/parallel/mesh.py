@@ -329,6 +329,11 @@ def initialize_distributed(coordinator_address=None, num_processes=None,
             return
     try:
         jax.distributed.initialize(**kwargs)
+        # events emitted before the rendezvous were stamped host 0 from a
+        # pre-init backend; drop that cache so the next emit re-resolves
+        from pyrecover_tpu.telemetry import bus as _telemetry_bus
+
+        _telemetry_bus.reset_process_index()
     except (ValueError, RuntimeError) as e:
         # A cluster env WAS detected (or explicitly given): failing half-way
         # must stop the job, not degrade it to N divergent solo runs.

@@ -93,6 +93,12 @@ def _run_trainer(cmd, *, fault_plan, log_path, timeout_s):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PALLAS_AXON_POOL_IPS", None)  # no accelerator plugin probing
+    # exercise telemetry JSONL rotation under real kill/resume cycles: a
+    # tiny byte cap forces several rotations per run, and the keep depth is
+    # raised so the merged read-back (and the event-trail gates below)
+    # still see the whole stream
+    env.setdefault("PYRECOVER_TELEMETRY_MAX_BYTES", "16384")
+    env.setdefault("PYRECOVER_TELEMETRY_KEEP", "50")
     if fault_plan is not None:
         env["PYRECOVER_FAULT_PLAN"] = json.dumps(fault_plan)
     else:
@@ -233,6 +239,8 @@ def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
             f"quarantined {quarantined[0]}, expected ckpt_{s2}_final*"
         )
 
+    # read_events merges rotated shards; the fault/recovery trail must
+    # survive rotation intact
     events = read_events(exp_dir / "chaos_telemetry.jsonl")
     counts = {}
     for e in events:
@@ -241,6 +249,15 @@ def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
                      "ckpt_precheck_failed"):
         if not counts.get(required):
             violations.append(f"no {required} telemetry event recorded")
+
+    # rotation gate: the byte cap set in _run_trainer must actually have
+    # rotated the live shard at least once across the kill/resume cycles —
+    # otherwise the soak stopped exercising the rotation path
+    rotated = len(list(exp_dir.glob("chaos_telemetry.jsonl.*")))
+    if os.environ.get("PYRECOVER_TELEMETRY_MAX_BYTES") is None and not rotated:
+        violations.append(
+            "telemetry JSONL never rotated despite the soak's byte cap"
+        )
 
     report = {
         "preset": preset_name,
@@ -258,6 +275,7 @@ def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
         "first_divergence": first_divergence,
         "rows": len(stitched_rows),
         "quarantined": quarantined,
+        "telemetry_rotated_shards": rotated,
         "telemetry_counts": {
             k: counts.get(k, 0)
             for k in ("fault_injected", "ckpt_io_retry", "ckpt_quarantined",

@@ -50,10 +50,13 @@ def io_retry(fn, *, op, path="", attempts=None, base_delay_s=0.05,
     if attempts is None:
         attempts = int(os.environ.get(ATTEMPTS_ENV, DEFAULT_ATTEMPTS))
     attempts = max(1, attempts)
+    t0 = None  # monotonic stamp of the FIRST failure (retries only)
     for attempt in range(1, attempts + 1):
         try:
-            return fn()
+            result = fn()
         except OSError as e:
+            if t0 is None:
+                t0 = time.monotonic()
             if attempt >= attempts or not is_transient(e):
                 raise
             delay = min(base_delay_s * (2.0 ** (attempt - 1)), max_delay_s)
@@ -64,3 +67,15 @@ def io_retry(fn, *, op, path="", attempts=None, base_delay_s=0.05,
                 error=f"{type(e).__name__}: {e}", delay_s=round(delay, 4),
             )
             sleep(delay)
+        else:
+            if t0 is not None:
+                # a retried call that eventually succeeded: one trace
+                # slice covering first-failure → success, and a sample in
+                # the retry-latency histogram — the slow-filesystem signal
+                # percentile reports surface long before saves start dying
+                telemetry.record_span(
+                    "io_retry", t0, time.monotonic(), op=op,
+                    path=str(path), attempts=attempt,
+                    metric="io_retry_latency_s",
+                )
+            return result

@@ -41,10 +41,22 @@ Core event names across the stack (fields beyond the envelope):
     ckpt_manifest_dtype_drift  path, detail (resume will cast the leaf)
     run_summary       status, step, + WallTimeTotals.as_dict() (goodput)
 
+Tracing + metrics events (``spans.py`` / ``metrics.py``; see README
+"Tracing & trace analysis" for the span catalog):
+    span_begin        name, span, parent, tid, thread, mono, ...
+    span_end          name, span, parent, tid, mono, dur_s [, ok, error]
+    span              retroactive span: name, span, parent, mono, dur_s
+    metrics_snapshot  reason, counters{}, gauges{}, hists{name: {count,
+                      sum, min, max, p50, p95, p99}}
+
 ``tools/summarize_telemetry.py`` turns a run's JSONL into a goodput
-report; ``sinks.read_events`` is the tolerant read-back it builds on.
+report; ``tools/traceview.py`` merges multi-host shards into a
+Perfetto-loadable Chrome trace + straggler/spike/regression analysis;
+``sinks.read_events`` is the tolerant (rotation-aware) read-back both
+build on.
 """
 
+from pyrecover_tpu.telemetry import metrics, spans
 from pyrecover_tpu.telemetry.bus import (
     add_sink,
     close,
@@ -58,7 +70,9 @@ from pyrecover_tpu.telemetry.sinks import (
     MemorySink,
     last_recorded_step,
     read_events,
+    rotated_paths,
 )
+from pyrecover_tpu.telemetry.spans import record_span, span
 
 __all__ = [
     "emit",
@@ -70,5 +84,10 @@ __all__ = [
     "MemorySink",
     "LogSink",
     "read_events",
+    "rotated_paths",
     "last_recorded_step",
+    "span",
+    "record_span",
+    "spans",
+    "metrics",
 ]

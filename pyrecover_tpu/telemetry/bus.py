@@ -21,16 +21,34 @@ import time
 
 _lock = threading.RLock()
 _sinks = []
+_host = None  # cached process index; None = not yet resolved
 
 
 def _process_index():
-    # Deferred import so telemetry works before jax.distributed init.
+    # Deferred import so telemetry works before jax.distributed init. The
+    # resolved index is CACHED: emit() runs on every event, and paying a
+    # jax attribute walk (worse, a swallowed ImportError) per event taxed
+    # exactly the hot paths telemetry promises not to touch. A failed
+    # resolution is NOT cached — the next emit retries, so events fired
+    # before jax is importable still pick up the real index later.
+    global _host
+    if _host is not None:
+        return _host
     try:
         import jax
 
-        return jax.process_index()
+        _host = jax.process_index()
+        return _host
     except Exception:
         return 0
+
+
+def reset_process_index():
+    """Forget the cached host index so the next emit re-resolves it.
+    Called once after ``jax.distributed.initialize`` — the index resolved
+    before the rendezvous (always 0) is stale on a pod."""
+    global _host
+    _host = None
 
 
 def enabled():

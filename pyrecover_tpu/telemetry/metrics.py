@@ -1,0 +1,204 @@
+"""Process-local metrics: counters, gauges, log-bucketed histograms.
+
+The aggregation side of the tracing layer: spans answer *where did this
+particular second go*, these answer *what is the distribution*. Producers
+update process-local state (a dict bump under a lock — no device syncs,
+no I/O); the registry is periodically flushed through the bus as ONE
+``metrics_snapshot`` event carrying every counter/gauge value and, per
+histogram, count/sum/min/max plus log-bucket counts and estimated
+p50/p95/p99.
+
+Histograms bucket on a geometric grid (``base = 2**0.25``, ~19% relative
+resolution — 4 buckets per octave), so a microsecond dispatch and a
+300-second checkpoint write live in the same fixed-size structure and
+percentile error is bounded by the bucket width. Zero/negative values land
+in a dedicated zero bucket (a loader that never stalls reports p50 = 0
+exactly).
+
+Wired-in histograms (see the train/loader/checkpoint/retry call sites):
+
+    step_iter_s        synced per-step wall time (interval average)
+    step_data_wait_s   per-step loader wait
+    step_dispatch_s    per-step dispatch/enqueue cost
+    loader_wait_s      consumer wait on the prefetch queue (0 on a hit)
+    ckpt_<engine>_<phase>_s   checkpoint lifecycle phases
+    io_retry_latency_s total wall time of io_retry calls that retried
+
+``flush()`` emits unconditionally; ``maybe_flush(interval_s)`` rate-limits
+for call sites inside the training loop. With no sink registered a flush
+is a no-op (the registry still accumulates — tests and bench read it
+directly via ``snapshot()``).
+"""
+
+import math
+import threading
+import time
+
+from pyrecover_tpu.telemetry import bus
+
+_BASE = 2.0 ** 0.25
+_LOG_BASE = math.log(_BASE)
+
+_lock = threading.Lock()
+_counters = {}
+_gauges = {}
+_histograms = {}
+_last_flush = [0.0]  # monotonic stamp of the last flush (boxed for mutation)
+
+
+class Counter:
+    """Monotonic event count."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name):  # jaxlint: host-only
+        self.name = name
+        self.value = 0
+
+    def inc(self, n=1):  # jaxlint: host-only
+        with _lock:
+            self.value += n
+
+
+class Gauge:
+    """Last-write-wins instantaneous value."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name):  # jaxlint: host-only
+        self.name = name
+        self.value = None
+
+    def set(self, v):  # jaxlint: host-only
+        self.value = v
+
+
+class Histogram:
+    """Log-bucketed distribution with exact count/sum/min/max."""
+
+    __slots__ = ("name", "count", "sum", "min", "max", "buckets")
+
+    def __init__(self, name):  # jaxlint: host-only
+        self.name = name
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+        self.buckets = {}  # bucket index (None = zero bucket) -> count
+
+    def observe(self, v, n=1):  # jaxlint: host-only
+        """Record ``v`` (``n`` times — the weight for interval averages
+        that stand in for n identical per-step samples)."""
+        v = float(v)
+        n = int(n)
+        if n <= 0:
+            return
+        if v <= 0.0:
+            idx = None
+        else:
+            idx = math.ceil(math.log(v) / _LOG_BASE - 1e-9)
+        with _lock:
+            self.count += n
+            self.sum += v * n
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            self.buckets[idx] = self.buckets.get(idx, 0) + n
+
+    def percentile(self, q):  # jaxlint: host-only
+        """Estimated q-quantile (0 < q <= 1): the geometric midpoint of the
+        bucket the quantile rank falls in, clamped to observed min/max."""
+        if self.count == 0:
+            return None
+        rank = q * self.count
+        items = sorted(
+            self.buckets.items(), key=lambda kv: (kv[0] is not None, kv[0] or 0)
+        )
+        cum = 0
+        for idx, n in items:
+            cum += n
+            if cum >= rank - 1e-9:
+                if idx is None:
+                    return 0.0
+                lo, hi = _BASE ** (idx - 1), _BASE ** idx
+                est = math.sqrt(lo * hi)
+                return min(max(est, self.min), self.max)
+        return self.max
+
+    def as_dict(self):  # jaxlint: host-only
+        d = {
+            "count": self.count,
+            "sum": round(self.sum, 6),
+            "min": round(self.min, 6) if self.min is not None else None,
+            "max": round(self.max, 6) if self.max is not None else None,
+        }
+        for label, q in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
+            p = self.percentile(q)
+            d[label] = round(p, 6) if p is not None else None
+        return d
+
+
+def counter(name):  # jaxlint: host-only
+    """Get-or-create the named counter."""
+    c = _counters.get(name)
+    if c is None:
+        with _lock:
+            c = _counters.setdefault(name, Counter(name))
+    return c
+
+
+def gauge(name):  # jaxlint: host-only
+    g = _gauges.get(name)
+    if g is None:
+        with _lock:
+            g = _gauges.setdefault(name, Gauge(name))
+    return g
+
+
+def histogram(name):  # jaxlint: host-only
+    h = _histograms.get(name)
+    if h is None:
+        with _lock:
+            h = _histograms.setdefault(name, Histogram(name))
+    return h
+
+
+def snapshot():  # jaxlint: host-only
+    """Point-in-time view of every registered metric (plain dicts)."""
+    with _lock:
+        counters = {name: c.value for name, c in _counters.items()}
+        gauges = {
+            name: g.value for name, g in _gauges.items()
+            if g.value is not None
+        }
+        hist_objs = list(_histograms.items())
+    hists = {name: h.as_dict() for name, h in hist_objs if h.count}
+    return {"counters": counters, "gauges": gauges, "hists": hists}
+
+
+def flush(reason=""):  # jaxlint: host-only
+    """Emit the current snapshot as one ``metrics_snapshot`` event (no-op
+    without sinks — the registry keeps accumulating either way)."""
+    _last_flush[0] = time.monotonic()
+    if not bus.enabled():
+        return None
+    snap = snapshot()
+    if not (snap["counters"] or snap["gauges"] or snap["hists"]):
+        return None
+    return bus.emit("metrics_snapshot", reason=reason, **snap)
+
+
+def maybe_flush(interval_s=30.0):  # jaxlint: host-only
+    """Flush at most once per ``interval_s`` — the training-loop call site
+    (sync points fire every few steps; snapshots should not)."""
+    if time.monotonic() - _last_flush[0] >= interval_s:
+        return flush(reason="interval")
+    return None
+
+
+def reset():  # jaxlint: host-only
+    """Drop every registered metric (test isolation / fresh run)."""
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+        _histograms.clear()
+        _last_flush[0] = 0.0

@@ -9,9 +9,29 @@ loss-CSV torn-row policy in ``metrics.LossCSVLogger``.
 
 import json
 import logging
+import os
 from pathlib import Path
 
 from pyrecover_tpu.telemetry.bus import _process_index
+
+# size-based rotation defaults (env-overridable so harnesses — chaos, the
+# e2e drivers — can exercise rotation on tiny runs without new CLI flags)
+MAX_BYTES_ENV = "PYRECOVER_TELEMETRY_MAX_BYTES"
+KEEP_ENV = "PYRECOVER_TELEMETRY_KEEP"
+DEFAULT_KEEP = 3
+
+
+def rotated_paths(path):
+    """Existing rotated shards for ``path``, OLDEST FIRST (``p.N`` down to
+    ``p.1``) — the read-back order that reconstructs the original stream
+    when followed by the live file."""
+    path = Path(path)
+    out = []
+    for p in path.parent.glob(path.name + ".*"):
+        suffix = p.name[len(path.name) + 1:]
+        if suffix.isdigit():
+            out.append((int(suffix), p))
+    return [p for _, p in sorted(out, reverse=True)]
 
 
 class JsonlSink:
@@ -21,23 +41,61 @@ class JsonlSink:
     to its own local file. ``append=False`` truncates (fresh run);
     ``append=True`` continues an existing stream (resume), which is what
     lets goodput accounting see the previous attempt's progress.
+
+    Size-based rotation (``max_bytes`` / ``$PYRECOVER_TELEMETRY_MAX_BYTES``):
+    once the live file crosses the limit it is renamed to ``<path>.1``
+    (older shards shifting to ``.2`` … ``.keep``; the oldest beyond
+    ``keep`` is deleted) and a fresh file is opened — a week-long soak
+    cannot fill the disk with telemetry. ``read_events`` transparently
+    merges the surviving shards, so goodput accounting and traceview see
+    one continuous stream.
     """
 
-    def __init__(self, path, *, host0_only=True, append=True):
+    def __init__(self, path, *, host0_only=True, append=True,
+                 max_bytes=None, keep=None):
         self.path = Path(path)
         self._file = None
+        if max_bytes is None:
+            max_bytes = int(os.environ.get(MAX_BYTES_ENV, "0")) or None
+        if keep is None:
+            keep = int(os.environ.get(KEEP_ENV, str(DEFAULT_KEEP)))
+        self.max_bytes = max_bytes
+        self.keep = max(int(keep), 1)
+        self._bytes = 0
         if host0_only and _process_index() != 0:
             return
         self.path.parent.mkdir(parents=True, exist_ok=True)
+        if not append:
+            # a fresh run must not leave a previous run's rotated shards
+            # behind: read_events would merge two unrelated streams
+            for p in rotated_paths(self.path):
+                p.unlink(missing_ok=True)
         self._file = open(self.path, "a" if append else "w")
+        if append and self.path.exists():
+            self._bytes = self.path.stat().st_size
+
+    def _rotate(self):
+        self._file.close()
+        self._file = None
+        shards = rotated_paths(self.path)  # oldest first
+        for n, p in [(int(p.name.rsplit(".", 1)[1]), p) for p in shards]:
+            if n + 1 > self.keep:
+                p.unlink(missing_ok=True)
+            else:
+                os.replace(p, self.path.with_name(f"{self.path.name}.{n + 1}"))
+        os.replace(self.path, self.path.with_name(self.path.name + ".1"))
+        self._file = open(self.path, "w")
+        self._bytes = 0
 
     def write(self, record):
         if self._file is None:
             return
-        self._file.write(
-            json.dumps(record, default=str, separators=(",", ":")) + "\n"
-        )
+        line = json.dumps(record, default=str, separators=(",", ":")) + "\n"
+        self._file.write(line)
         self._file.flush()
+        self._bytes += len(line)
+        if self.max_bytes and self._bytes >= self.max_bytes:
+            self._rotate()
 
     def close(self):
         if self._file is not None:
@@ -77,28 +135,32 @@ class LogSink:
         pass
 
 
-def read_events(path):
-    """All parseable events from a telemetry JSONL, in file order.
+def read_events(path, *, include_rotated=True):
+    """All parseable events from a telemetry JSONL, in file order —
+    rotated shards (``path.N`` … ``path.1``) are prepended oldest-first so
+    a rotated stream reads back as one continuous sequence.
 
     Torn lines (a kill mid-write), blank lines, and non-event JSON are
     skipped, never raised — the stream is observability, not state.
     Returns [] for a missing file.
     """
     path = Path(path)
-    if not path.exists():
-        return []
+    files = (rotated_paths(path) if include_rotated else []) + [path]
     out = []
-    with open(path, "r", errors="replace") as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue
-            if isinstance(rec, dict) and "event" in rec:
-                out.append(rec)
+    for p in files:
+        if not p.exists():
+            continue
+        with open(p, "r", errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and "event" in rec:
+                    out.append(rec)
     return out
 
 

@@ -1,0 +1,187 @@
+"""Hierarchical tracing spans on monotonic clocks, emitted through the bus.
+
+``span("ckpt_save", engine="sharded")`` opens a timed region::
+
+    with spans.span("ckpt_save", engine="sharded", step=12):
+        ... serialize / write / commit ...
+
+Each span emits a ``span_begin`` and ``span_end`` event pair through the
+existing telemetry bus (so the JSONL shard each host writes carries its
+own trace), stamped with BOTH clocks:
+
+  * ``ts``   — wall seconds (bus envelope), comparable across hosts after
+    ``traceview``'s anchor-based alignment;
+  * ``mono`` — ``time.monotonic()`` seconds, immune to NTP steps, the
+    clock durations are computed on.
+
+Span identity: a process-unique integer id plus the emitting thread's
+ident (``tid``). Nesting is tracked per-thread (a thread-local stack), so
+the async checkpoint writer, the maintenance watcher, and the loader
+prefetch threads each build their own correctly-nested trace without
+locking against the train loop. ``span_end`` records ``dur_s`` and — when
+the body raised — ``ok=False`` with the exception type, so a trace shows
+exactly which save attempt died.
+
+Cost model: with no sink registered ``span()`` returns a shared no-op
+context manager — two attribute loads and a truth test, no allocation, no
+clock read — so instrumentation points are free on un-instrumented runs.
+With sinks active a span costs two ``emit`` calls.
+
+``record_span`` writes a RETROACTIVE span (one ``span`` event carrying
+``mono``+``dur_s``): the train hot loop buffers per-step timestamps and
+emits its step/data-wait/dispatch spans at the next sync point, so tracing
+never adds file I/O between dispatches.
+
+``metric="hist_name"`` on any span additionally folds the duration into
+the named :mod:`pyrecover_tpu.telemetry.metrics` histogram — one call
+site wires both the trace slice and the percentile accounting.
+"""
+
+import threading
+import time
+
+from pyrecover_tpu.telemetry import bus
+
+_local = threading.local()
+_id_lock = threading.Lock()
+_next_id = 0
+
+
+def _new_id():  # jaxlint: host-only
+    global _next_id
+    with _id_lock:
+        _next_id += 1
+        return _next_id
+
+
+def _stack():  # jaxlint: host-only
+    s = getattr(_local, "stack", None)
+    if s is None:
+        s = _local.stack = []
+    return s
+
+
+def current_span_id():  # jaxlint: host-only
+    """Id of the innermost open span on THIS thread, or None."""
+    s = getattr(_local, "stack", None)
+    return s[-1] if s else None
+
+
+class Span:
+    """An open span. Use via ``span(...)`` (context manager) or
+    ``begin(...)``/``.end()`` for regions that don't nest lexically
+    (the jax.profiler window)."""
+
+    __slots__ = ("name", "fields", "span_id", "parent_id", "t0", "metric",
+                 "_open")
+
+    def __init__(self, name, fields, metric=None):  # jaxlint: host-only
+        self.name = name
+        self.fields = fields
+        self.metric = metric
+        self.span_id = _new_id()
+        stack = _stack()
+        self.parent_id = stack[-1] if stack else None
+        stack.append(self.span_id)
+        self._open = True
+        self.t0 = time.monotonic()
+        bus.emit(
+            "span_begin", name=name, span=self.span_id,
+            parent=self.parent_id, tid=threading.get_ident(),
+            thread=threading.current_thread().name,
+            mono=round(self.t0, 6), **fields,
+        )
+
+    def end(self, ok=True, error=None):  # jaxlint: host-only
+        """Close the span (idempotent)."""
+        if not self._open:
+            return
+        self._open = False
+        t1 = time.monotonic()
+        stack = _stack()
+        # tolerate out-of-order closes (a begin/end pair crossing a
+        # callback boundary): pop down to and including this span
+        if self.span_id in stack:
+            del stack[stack.index(self.span_id):]
+        dur = t1 - self.t0
+        extra = {} if ok else {"ok": False, "error": error or ""}
+        bus.emit(
+            "span_end", name=self.name, span=self.span_id,
+            parent=self.parent_id, tid=threading.get_ident(),
+            mono=round(t1, 6), dur_s=round(dur, 6), **extra, **self.fields,
+        )
+        if self.metric is not None:
+            from pyrecover_tpu.telemetry import metrics
+
+            metrics.histogram(self.metric).observe(dur)
+
+    def __enter__(self):  # jaxlint: host-only
+        return self
+
+    def __exit__(self, exc_type, exc, tb):  # jaxlint: host-only
+        if exc_type is None:
+            self.end()
+        else:
+            self.end(ok=False, error=f"{exc_type.__name__}: {exc}")
+        return False
+
+
+class _NullSpan:
+    """Shared no-op span: what ``span()`` hands back when no sink is
+    registered. Every method is a constant-time no-op."""
+
+    __slots__ = ()
+    span_id = None
+    parent_id = None
+
+    def end(self, ok=True, error=None):  # jaxlint: host-only
+        pass
+
+    def __enter__(self):  # jaxlint: host-only
+        return self
+
+    def __exit__(self, exc_type, exc, tb):  # jaxlint: host-only
+        return False
+
+
+_NULL = _NullSpan()
+
+
+def span(name, *, metric=None, **fields):  # jaxlint: host-only
+    """Open a span context manager (no-op without sinks)."""
+    if not bus.enabled():
+        return _NULL
+    return Span(name, fields, metric=metric)
+
+
+def begin(name, *, metric=None, **fields):  # jaxlint: host-only
+    """Open a span without a ``with`` block; close it with ``.end()``.
+    For windows that outlive a lexical scope (profiler start/stop)."""
+    if not bus.enabled():
+        return _NULL
+    return Span(name, fields, metric=metric)
+
+
+# jaxlint: host-only
+def record_span(name, begin_mono, end_mono, *, parent=None, metric=None,
+                **fields):
+    """Record an already-elapsed span from two ``time.monotonic()`` stamps
+    (one ``span`` event, no begin/end pair). The hot-loop path: timestamps
+    are captured per step, the event is written at the next sync point.
+    Returns the span id (or None without sinks)."""
+    dur = max(end_mono - begin_mono, 0.0)
+    if metric is not None:
+        from pyrecover_tpu.telemetry import metrics
+
+        metrics.histogram(metric).observe(dur)
+    if not bus.enabled():
+        return None
+    span_id = _new_id()
+    if parent is None:
+        parent = current_span_id()
+    bus.emit(
+        "span", name=name, span=span_id, parent=parent,
+        tid=threading.get_ident(), mono=round(begin_mono, 6),
+        dur_s=round(dur, 6), **fields,
+    )
+    return span_id

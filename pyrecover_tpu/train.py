@@ -385,6 +385,9 @@ def train(config: TrainConfig):
         return _train_impl(config, totals, t_entry, owned_sinks, status)
     finally:
         totals.wall_s = time.monotonic() - t_entry
+        # final percentile snapshot first: the run_summary consumer gets
+        # goodput AND the step-time/ckpt-phase distributions in one stream
+        telemetry.metrics.flush(reason="run_end")
         telemetry.emit(
             "run_summary", status=status["status"], step=status["step"],
             **totals.as_dict(),
@@ -507,6 +510,10 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
         # has stopped waiting, so must we
         if watcher is not None:
             watcher.arm_escalation(exp_dir, step)
+        save_span = telemetry.spans.begin(
+            "ckpt_save", step=int(step), final=bool(final),
+            engine="sharded" if config.sharded_checkpoint else "vanilla",
+        )
         try:
             if config.sharded_checkpoint:
                 secs = sharded_ckptr.save(
@@ -532,9 +539,13 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
                         max_keep=config.max_kept_checkpoints,
                         extra_meta=extra,
                     )
+        except BaseException as e:
+            save_span.end(ok=False, error=f"{type(e).__name__}: {e}")
+            raise
         finally:
             if watcher is not None:
                 watcher.disarm_escalation()
+        save_span.end()
         log_host0("Saved checkpoint %s in %.2f s", path.name, secs)
         telemetry.emit(
             "ckpt_saved", step=int(step), path=path.name, final=bool(final),
@@ -551,9 +562,10 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
     start_step = 0
     if config.resume_from_checkpoint:
         try:
-            start_step, state = _resume(
-                config, exp_dir, state, sampler, sharded_ckptr, totals
-            )
+            with telemetry.span("resume", metric="resume_s"):
+                start_step, state = _resume(
+                    config, exp_dir, state, sampler, sharded_ckptr, totals
+                )
         except BaseException:
             # the teardown try/finally only starts after loader.start();
             # a failed resume (wrong model config, every-candidate-corrupt)
@@ -583,6 +595,7 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
     step = start_step
     stopped_early = False
     profiling = False
+    prof_span = None
     run_eval = None
     watcher = None
     csv_logger = None
@@ -637,7 +650,10 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
         # restart tax on a resumed run; the checkpoint load is its own bucket
         totals.setup_s = max(train_t0 - t_entry - totals.ckpt_load_s, 0.0)
         pending_tokens = []
-        step_times = []  # (step, data_wait_s, dispatch_s) awaiting a sync point
+        # (step, iter_t0, t_data, t_dispatch) monotonic stamps awaiting a
+        # sync point — both the step_time events and the retroactive
+        # step/data_wait/dispatch trace spans are written from this buffer
+        step_times = []
         sync_t0 = time.monotonic()
         steps_since_sync = 0
 
@@ -658,10 +674,22 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
                     if replayed > 0:
                         totals.replayed_steps += replayed
                         totals.replayed_s += dt * replayed / n
-            for s_, dw, dd in step_times:
+            for s_, t0_, td_, tp_ in step_times:
                 telemetry.emit(
-                    "step_time", step=s_, data_wait_s=round(dw, 6),
-                    dispatch_s=round(dd, 6),
+                    "step_time", step=s_, data_wait_s=round(td_ - t0_, 6),
+                    dispatch_s=round(tp_ - td_, 6),
+                )
+                # retroactive trace spans from the buffered stamps: the
+                # hot loop never pays the span I/O, the trace still shows
+                # per-step data-wait vs dispatch slices at the real times
+                sid = telemetry.record_span("step", t0_, tp_, step=s_)
+                telemetry.record_span(
+                    "data_wait", t0_, td_, step=s_, parent=sid,
+                    metric="step_data_wait_s",
+                )
+                telemetry.record_span(
+                    "dispatch", td_, tp_, step=s_, parent=sid,
+                    metric="step_dispatch_s",
                 )
             step_times.clear()
             sync_t0 = now
@@ -675,6 +703,12 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
                     and step == config.profile_step_start
                     and not profiling
                 ):
+                    # span wraps the whole profiler window so the JSONL
+                    # trace and the jax profile correlate on the timeline
+                    prof_span = telemetry.spans.begin(
+                        "jax_profile", dir=str(config.profile_dir),
+                        start_step=step,
+                    )
                     jax.profiler.start_trace(config.profile_dir)
                     profiling = True
 
@@ -695,9 +729,7 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
                     # jaxlint: disable-next=untimed-device-work -- measuring
                     # the enqueue cost is the point; a block_until_ready here
                     # would serialize the hot loop it instruments
-                    step_times.append(
-                        (step, t_data - iter_t0, t_dispatch - t_data)
-                    )
+                    step_times.append((step, iter_t0, t_data, t_dispatch))
                 pending_tokens.append(metrics["n_tokens"])
                 if csv_logger.enabled:
                     pending_losses.append((step, metrics["loss"]))
@@ -724,6 +756,18 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
                     # where it spikes)
                     dt, n = close_interval(time.monotonic())
                     watcher.observe_iter(dt / n)
+                    # the deliberate sync is itself a trace slice, and the
+                    # interval-average iter time feeds the step-time
+                    # histogram (weight n: it stands in for n steps)
+                    telemetry.record_span(
+                        "loss_sync", t_sync0, t_sync0 + sync_s, step=step,
+                    )
+                    telemetry.metrics.histogram("step_iter_s").observe(
+                        dt / n, n=n
+                    )
+                    telemetry.metrics.maybe_flush(
+                        interval_s=config.metrics_flush_interval_s
+                    )
                     telemetry.emit(
                         "train_sync", step=step, loss=round(loss, 6),
                         steps=n, interval_s=round(dt, 6),
@@ -741,13 +785,15 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
 
                 if config.profile and step == config.profile_step_end and profiling:
                     jax.profiler.stop_trace()
+                    prof_span.end()
                     profiling = False
 
                 # held-out evaluation (beyond-parity)
                 if run_eval is not None and step % config.eval_frequency == 0:
                     close_interval(time.monotonic())
                     eval_t0 = time.monotonic()
-                    eval_loss = run_eval(state)
+                    with telemetry.span("eval", step=step, metric="eval_s"):
+                        eval_loss = run_eval(state)
                     eval_s = time.monotonic() - eval_t0
                     totals.eval_s += eval_s
                     log_host0("eval | step %d | loss %.4f", step, eval_loss)
@@ -795,6 +841,7 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
         unwinding = sys.exc_info()[0] is not None
         if profiling:
             jax.profiler.stop_trace()
+            prof_span.end()
         loader.stop()
         if run_eval is not None:
             run_eval.loader.stop()

@@ -352,6 +352,66 @@ def poll(readings):  # jaxlint: hot-loop
     assert names(lint_source(src)) == ["host-sync-in-hot-loop"]
 
 
+def test_span_body_still_trips_host_sync_in_hot_loop():
+    """A `with span(...)` block is NOT a function boundary: a device sync
+    inside the instrumented region of the hot loop must still fire JX01 —
+    instrumentation must never launder a sync past the linter."""
+    src = """
+import jax
+from pyrecover_tpu.telemetry import spans
+
+def _train_impl(loader, step_fn, state):
+    while True:
+        batch = next(loader)
+        with spans.span("step"):
+            state, metrics = step_fn(state, batch)
+            loss = float(metrics["loss"])
+"""
+    result = lint_source(src)
+    assert "host-sync-in-hot-loop" in names(result)
+
+
+def test_span_wrapped_hot_loop_clean_when_buffered():
+    """The clean twin: spans in the hot loop with the loss buffered to a
+    sync point lint clean — tracing itself is not a sync."""
+    src = """
+import jax
+from pyrecover_tpu.telemetry import spans, metrics
+
+def _train_impl(loader, step_fn, state):
+    pending = []
+    while True:
+        batch = next(loader)
+        with spans.span("step"):
+            state, m = step_fn(state, batch)
+        pending.append(m["loss"])
+        metrics.histogram("step_iter_s").observe(0.01)
+    return pending
+"""
+    assert names(lint_source(src)) == []
+
+
+def test_span_metrics_apis_are_host_only_pruned():
+    """The shipped span/metrics APIs carry `# jaxlint: host-only` markers:
+    hot-path reachability must stop at their door (their internal loops
+    over host data would otherwise false-positive JX01), pinned here
+    against the real package sources."""
+    from pyrecover_tpu.analysis.callgraph import ProjectIndex, build_hot_set
+    from pyrecover_tpu.analysis.engine import DEFAULT_CONFIG
+
+    pkg = REPO / "pyrecover_tpu"
+    modules = []
+    for rel in ("train.py", "telemetry/spans.py", "telemetry/metrics.py"):
+        p = pkg / rel
+        modules.append(ModuleInfo(p, p.read_text(), relpath=p))
+    hot = build_hot_set(ProjectIndex(modules), DEFAULT_CONFIG)
+    hot_files = {str(fn.module.relpath) for fn in hot}
+    assert any(s.endswith("train.py") for s in hot_files)
+    assert not any(
+        s.endswith(("spans.py", "metrics.py")) for s in hot_files
+    ), "span/metrics APIs must be host-only-pruned from the hot set"
+
+
 def test_hot_reachability_crosses_modules():
     """_train_impl in one module calls a helper in another; a loop sync in
     the helper is attributed there."""

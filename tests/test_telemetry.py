@@ -106,6 +106,59 @@ def test_jsonl_sink_host0_gating(tmp_path, monkeypatch):
     assert len(telemetry.read_events(path)) == 1
 
 
+def test_jsonl_sink_rotation_keeps_stream_readable(tmp_path):
+    """Size-based rotation: the live file never grows unbounded, the
+    shifted shards keep their order, and read_events merges them back
+    into one continuous stream."""
+    path = tmp_path / "t.jsonl"
+    sink = telemetry.add_sink(
+        telemetry.JsonlSink(path, max_bytes=200, keep=10)
+    )
+    for i in range(40):
+        telemetry.emit("e", i=i)
+    telemetry.close()
+    rotated = telemetry.rotated_paths(path)
+    assert rotated, "the byte cap must have rotated at least once"
+    assert all(p.stat().st_size <= 400 for p in rotated + [path])
+    evs = telemetry.read_events(path)
+    assert [e["i"] for e in evs] == list(range(40))  # merged, in order
+    # oldest-first: shard .N holds the lowest indices
+    first = telemetry.read_events(rotated[0], include_rotated=False)
+    assert first[0]["i"] == 0
+
+
+def test_jsonl_sink_rotation_drops_beyond_keep(tmp_path):
+    path = tmp_path / "t.jsonl"
+    sink = telemetry.JsonlSink(path, max_bytes=80, keep=2)
+    for i in range(50):
+        sink.write({"event": "e", "ts": 0, "host": 0, "i": i})
+    sink.close()
+    assert len(telemetry.rotated_paths(path)) == 2  # .1 and .2 only
+    evs = telemetry.read_events(path)
+    # the tail survives contiguously; the oldest shards were dropped
+    assert [e["i"] for e in evs] == list(range(evs[0]["i"], 50))
+    assert evs[0]["i"] > 0
+
+
+def test_jsonl_sink_fresh_run_clears_stale_rotated_shards(tmp_path):
+    path = tmp_path / "t.jsonl"
+    (tmp_path / "t.jsonl.1").write_text(
+        '{"event":"stale","ts":0,"host":0}\n'
+    )
+    sink = telemetry.JsonlSink(path, append=False)
+    sink.write({"event": "fresh", "ts": 1, "host": 0})
+    sink.close()
+    assert [e["event"] for e in telemetry.read_events(path)] == ["fresh"]
+
+
+def test_jsonl_sink_rotation_env_defaults(tmp_path, monkeypatch):
+    monkeypatch.setenv("PYRECOVER_TELEMETRY_MAX_BYTES", "150")
+    monkeypatch.setenv("PYRECOVER_TELEMETRY_KEEP", "5")
+    sink = telemetry.JsonlSink(tmp_path / "t.jsonl")
+    assert sink.max_bytes == 150 and sink.keep == 5
+    sink.close()
+
+
 def test_read_events_tolerates_torn_lines(tmp_path):
     path = tmp_path / "t.jsonl"
     path.write_text(
@@ -250,11 +303,15 @@ def test_requeue_marker_roundtrip(tmp_path):
 
 
 @pytest.mark.slow
-def test_resume_cycle_counts_replayed_steps(tmp_path):
+def test_resume_cycle_counts_replayed_steps(tmp_path, monkeypatch):
     """End-to-end: run to step 6 (ckpt at 3), simulate a crash by deleting
     everything after ckpt_3, resume to 9 — the resumed run must count the
     3 replayed steps in its goodput accounting and the summarizer must
-    render the productive-vs-lost split."""
+    render the productive-vs-lost split. Telemetry rotation is forced via
+    the env cap: the stream must survive rotation + a kill + a resume and
+    still read back as one sequence."""
+    monkeypatch.setenv("PYRECOVER_TELEMETRY_MAX_BYTES", "4096")
+    monkeypatch.setenv("PYRECOVER_TELEMETRY_KEEP", "50")
     from pyrecover_tpu.config import TrainConfig
     from pyrecover_tpu.models import ModelConfig
     from pyrecover_tpu.train import train
@@ -282,11 +339,13 @@ def test_resume_cycle_counts_replayed_steps(tmp_path):
     assert end_step == 9 and not stopped
 
     tele = exp_dir / "exp_telemetry.jsonl"
+    assert telemetry.rotated_paths(tele), "the 4 KiB cap must have rotated"
     evs = telemetry.read_events(tele)
     names = {e["event"] for e in evs}
     assert {"run_start", "step_time", "train_sync", "ckpt_save_start",
             "ckpt_commit", "ckpt_saved", "resume", "resume_replay",
-            "run_summary"} <= names
+            "run_summary", "span", "span_begin", "span_end",
+            "metrics_snapshot"} <= names
 
     summaries = [e for e in evs if e["event"] == "run_summary"]
     # first attempt replays nothing; the resumed attempt replays 4..6

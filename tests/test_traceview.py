@@ -1,0 +1,234 @@
+"""traceview: multi-host shard merge, anchor-based clock alignment,
+Chrome-trace export validity (pairing/nesting), straggler attribution,
+spike detection, and the checkpoint-phase baseline gate."""
+
+import json
+
+import pytest
+
+from pyrecover_tpu.telemetry import traceview
+
+
+def write_shard(path, events):
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    return path
+
+
+def synth_host(host, *, skew=0.0, iter_s=0.010, steps=20, spike_at=None,
+               ckpt_write_s=0.05):
+    """One host's telemetry shard: per-step train_sync/step_time events
+    plus a checkpoint save span pair, with the host's wall clock shifted
+    by ``skew`` seconds (what unsynced NTP looks like)."""
+    t0 = 1000.0 + skew
+    mono = 500.0  # monotonic clocks are arbitrary per host
+    events = [{"event": "run_start", "ts": t0, "host": host, "devices": 8}]
+    t = t0
+    for step in range(1, steps + 1):
+        dt = iter_s * (10.0 if step == spike_at else 1.0)
+        t += dt
+        mono += dt
+        events.append({
+            "event": "step_time", "ts": t, "host": host, "step": step,
+            "data_wait_s": 0.001, "dispatch_s": dt - 0.001,
+        })
+        events.append({
+            "event": "train_sync", "ts": t, "host": host, "step": step,
+            "loss": 5.0 - 0.01 * step, "steps": 1, "interval_s": dt,
+            "iter_s": dt, "sync_s": 0.0005,
+        })
+    # a checkpoint save with nested write phase (span pairing + phases)
+    sid, wid = 900 + host * 10, 901 + host * 10
+    events += [
+        {"event": "ckpt_save_start", "ts": t + 0.001, "host": host,
+         "engine": "vanilla", "path": "ckpt_20.ckpt"},
+        {"event": "span_begin", "ts": t + 0.001, "host": host,
+         "name": "ckpt_save", "span": sid, "parent": None, "tid": 1,
+         "thread": "MainThread", "mono": mono + 0.001, "engine": "vanilla"},
+        {"event": "span_begin", "ts": t + 0.002, "host": host,
+         "name": "ckpt_write", "span": wid, "parent": sid, "tid": 1,
+         "mono": mono + 0.002, "engine": "vanilla"},
+        {"event": "span_end", "ts": t + 0.002 + ckpt_write_s, "host": host,
+         "name": "ckpt_write", "span": wid, "parent": sid, "tid": 1,
+         "mono": mono + 0.002 + ckpt_write_s, "dur_s": ckpt_write_s,
+         "engine": "vanilla"},
+        {"event": "span_end", "ts": t + 0.003 + ckpt_write_s, "host": host,
+         "name": "ckpt_save", "span": sid, "parent": None, "tid": 1,
+         "mono": mono + 0.003 + ckpt_write_s,
+         "dur_s": ckpt_write_s + 0.002, "engine": "vanilla"},
+        {"event": "ckpt_commit", "ts": t + 0.003 + ckpt_write_s,
+         "host": host, "engine": "vanilla", "path": "ckpt_20.ckpt",
+         "bytes": 1000, "write_s": ckpt_write_s},
+    ]
+    return events
+
+
+@pytest.fixture()
+def two_hosts(tmp_path):
+    """host 0 on time; host 1 slow (2x step time) AND 120 s clock skew."""
+    p0 = write_shard(tmp_path / "h0.jsonl", synth_host(0))
+    p1 = write_shard(
+        tmp_path / "h1.jsonl", synth_host(1, skew=120.0, iter_s=0.020)
+    )
+    return p0, p1
+
+
+# ---- merge + alignment ------------------------------------------------------
+
+
+def test_clock_alignment_recovers_skew(two_hosts):
+    shards = traceview.load_shards(two_hosts)
+    traceview.align_clocks(shards)
+    by_host = {s.host: s for s in shards}
+    assert by_host[0].offset == 0.0  # reference shard
+    # host 1's anchors carry the +120 s skew plus the genuine step-time
+    # difference; the median delta recovers ~-120 s
+    assert by_host[1].offset == pytest.approx(-120.0, abs=1.0)
+
+
+def test_disjoint_shards_align_to_zero(tmp_path):
+    p0 = write_shard(tmp_path / "a.jsonl", synth_host(0))
+    p1 = write_shard(tmp_path / "b.jsonl", [
+        {"event": "run_start", "ts": 5000.0, "host": 3},
+        {"event": "train_sync", "ts": 5001.0, "host": 3, "step": 999,
+         "iter_s": 0.01, "steps": 1},
+    ])
+    shards = traceview.load_shards([p0, p1])
+    traceview.align_clocks(shards)
+    assert all(s.offset == 0.0 for s in shards)
+
+
+# ---- Chrome trace export ----------------------------------------------------
+
+
+def test_chrome_trace_valid_and_nested(two_hosts, tmp_path):
+    out = tmp_path / "trace.json"
+    rc = traceview.main([str(p) for p in two_hosts] + ["--out", str(out)])
+    assert rc == 0
+    trace = json.loads(out.read_text())  # valid JSON by construction
+    evs = trace["traceEvents"]
+    assert evs, "trace must not be empty"
+    x = [e for e in evs if e["ph"] == "X"]
+    # spans paired: each host contributes exactly one ckpt_save/ckpt_write
+    saves = [e for e in x if e["name"] == "ckpt_save"]
+    writes = [e for e in x if e["name"] == "ckpt_write"]
+    assert len(saves) == 2 and len(writes) == 2
+    for e in x:
+        assert e["ts"] >= 0 and e["dur"] >= 1
+        assert isinstance(e["pid"], int)
+    # nesting: each write slice lies inside its host's save slice
+    for pid in {e["pid"] for e in saves}:
+        (s,) = [e for e in saves if e["pid"] == pid]
+        (w,) = [e for e in writes if e["pid"] == pid]
+        assert s["ts"] <= w["ts"]
+        assert w["ts"] + w["dur"] <= s["ts"] + s["dur"] + 1
+    # per-shard process metadata is present
+    assert any(
+        e["ph"] == "M" and e["name"] == "process_name" for e in evs
+    )
+    # instant markers for non-span events ride along
+    assert any(e["ph"] == "i" and e["name"] == "ckpt_commit" for e in evs)
+
+
+def test_truncated_span_is_closed_not_dropped(tmp_path):
+    events = synth_host(0)[:-3]  # drop ckpt_write end, ckpt_save end, commit
+    p = write_shard(tmp_path / "torn.jsonl", events)
+    shards = traceview.load_shards([p])
+    spans = traceview.pair_spans(shards[0])
+    truncated = [s for s in spans if s["args"].get("truncated")]
+    assert len(truncated) == 2  # both opens synthesized closed
+    assert all(not s["ok"] for s in truncated)
+
+
+def test_retroactive_spans_place_at_true_begin(tmp_path):
+    """Buffered `span` events carry the emit-time ts but the true begin
+    mono; the exporter must NOT stack them at the sync point."""
+    events = [
+        {"event": "span_begin", "ts": 1000.0, "mono": 100.0, "host": 0,
+         "name": "anchor", "span": 1, "parent": None, "tid": 1},
+        {"event": "span_end", "ts": 1000.1, "mono": 100.1, "host": 0,
+         "name": "anchor", "span": 1, "parent": None, "tid": 1,
+         "dur_s": 0.1},
+        # emitted at ts=1005 (a sync point) but actually ran 101.0..101.5
+        {"event": "span", "ts": 1005.0, "mono": 101.0, "host": 0,
+         "name": "step", "span": 2, "parent": None, "tid": 1,
+         "dur_s": 0.5, "step": 3},
+    ]
+    p = write_shard(tmp_path / "retro.jsonl", events)
+    (shard,) = traceview.load_shards([p])
+    spans = {s["name"]: s for s in traceview.pair_spans(shard)}
+    # mono 101.0 maps to wall 1001.0 via the anchor's ts-mono base
+    assert spans["step"]["ts"] == pytest.approx(1001.0, abs=0.01)
+
+
+# ---- analysis ---------------------------------------------------------------
+
+
+def test_straggler_names_seeded_slow_host(two_hosts, capsys):
+    rc = traceview.main([str(p) for p in two_hosts])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.strip(), "analysis report must be non-empty"
+    shards = traceview.load_shards(two_hosts)
+    traceview.align_clocks(shards)
+    report = traceview.analyze(shards)
+    st = report["step_times"]["straggler"]
+    assert st["host"] == 1  # the seeded 2x-slow host
+    assert st["delta_pct"] > 50
+    assert "STRAGGLER: host 1" in out
+
+
+def test_single_shard_report_nonempty_no_straggler(tmp_path, capsys):
+    p = write_shard(tmp_path / "solo.jsonl", synth_host(0))
+    assert traceview.main([str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "per-host step times" in out and "host 0" in out
+    shards = traceview.load_shards([p])
+    report = traceview.analyze(shards)
+    assert report["step_times"]["straggler"] is None
+
+
+def test_spike_detection_flags_rolling_median_outlier(tmp_path):
+    p = write_shard(
+        tmp_path / "spiky.jsonl", synth_host(0, spike_at=15)
+    )
+    shards = traceview.load_shards([p])
+    report = traceview.analyze(shards)
+    spikes = report["step_times"]["spikes"]
+    assert [s["step"] for s in spikes] == [15]
+    assert spikes[0]["factor"] >= 5
+
+
+def test_ckpt_phase_baseline_regression_gates(tmp_path, capsys):
+    fast = write_shard(tmp_path / "fast.jsonl", synth_host(0))
+    slow = write_shard(
+        tmp_path / "slow.jsonl", synth_host(0, ckpt_write_s=0.5)
+    )
+    base = tmp_path / "base.json"
+    assert traceview.main([str(fast), "--write-baseline", str(base)]) == 0
+    baseline = json.loads(base.read_text())
+    assert baseline["vanilla:ckpt_write"] == pytest.approx(0.05, rel=0.01)
+    # same shard vs its own baseline: clean
+    assert traceview.main([str(fast), "--baseline", str(base)]) == 0
+    capsys.readouterr()
+    # 10x slower write: the regression gate trips (exit 1) and names it
+    assert traceview.main([str(slow), "--baseline", str(base)]) == 1
+    assert "REGRESSION: vanilla:ckpt_write" in capsys.readouterr().out
+
+
+def test_no_events_exit_2(tmp_path, capsys):
+    missing = tmp_path / "nope.jsonl"
+    assert traceview.main([str(missing)]) == 2
+
+
+def test_report_json_shape(two_hosts, tmp_path):
+    rj = tmp_path / "report.json"
+    assert traceview.main(
+        [str(p) for p in two_hosts] + ["--report-json", str(rj)]
+    ) == 0
+    report = json.loads(rj.read_text())
+    assert {"shards", "step_times", "ckpt_phases"} <= set(report)
+    assert len(report["shards"]) == 2
+    hosts = {h["host"] for h in report["step_times"]["hosts"]}
+    assert hosts == {0, 1}

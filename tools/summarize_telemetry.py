@@ -37,6 +37,21 @@ def _mean(xs):
     return sum(xs) / len(xs) if xs else 0.0
 
 
+def _wpercentile(samples, q):
+    """Weighted percentile over [(value, weight)] samples, or None."""
+    if not samples:
+        return None
+    samples = sorted(samples)
+    total = sum(w for _, w in samples)
+    rank = q * total
+    cum = 0.0
+    for v, w in samples:
+        cum += w
+        if cum >= rank - 1e-12:
+            return v
+    return samples[-1][0]
+
+
 def segments(events):
     """Split the stream into run segments: run_start .. run_summary."""
     segs = []
@@ -89,17 +104,40 @@ def aggregate(events):
 
     steps = by.get("step_time", [])
     syncs = by.get("train_sync", [])
+    # synced-interval step-time percentiles: each train_sync contributes
+    # its interval-average iter_s weighted by the steps it covered — the
+    # same numbers bench.py's metrics_snapshot percentiles report
+    iter_samples = [
+        (float(e["iter_s"]), int(e.get("steps", 1)) or 1)
+        for e in syncs if isinstance(e.get("iter_s"), (int, float))
+    ]
+
+    def _pct(q):
+        p = _wpercentile(iter_samples, q)
+        return round(p, 6) if p is not None else None
+
     agg["steps"] = {
         "recorded": len(steps),
         "data_wait_s_mean": round(_mean([e["data_wait_s"] for e in steps]), 6),
         "data_wait_s_max": round(max([e["data_wait_s"] for e in steps], default=0.0), 6),
         "dispatch_s_mean": round(_mean([e["dispatch_s"] for e in steps]), 6),
         "iter_s_mean": round(_mean([e["iter_s"] for e in syncs]), 6),
+        "iter_s_p50": _pct(0.50),
+        "iter_s_p95": _pct(0.95),
+        "iter_s_p99": _pct(0.99),
         "sync_s_mean": round(_mean([e["sync_s"] for e in syncs]), 6),
     }
     if syncs:
         agg["loss_first"] = syncs[0].get("loss")
         agg["loss_last"] = syncs[-1].get("loss")
+
+    # latest metrics_snapshot per histogram: the flushed registry carries
+    # loader-wait / ckpt-phase / retry-latency percentiles per host
+    hists = {}
+    for e in by.get("metrics_snapshot", []):
+        for name, h in (e.get("hists") or {}).items():
+            hists[name] = h
+    agg["metric_hists"] = hists
 
     ckpt = {}
     for e in by.get("ckpt_save_blocking", []):
@@ -197,8 +235,22 @@ def render(agg, out=None):
         w(f"  dispatch           mean {st['dispatch_s_mean'] * 1e3:.2f}ms\n")
         w(f"  synced iter time   mean {st['iter_s_mean'] * 1e3:.2f}ms"
           f"  (sync cost mean {st['sync_s_mean'] * 1e3:.2f}ms)\n")
+        if st.get("iter_s_p50") is not None:
+            w(f"  iter percentiles   p50 {st['iter_s_p50'] * 1e3:.2f}ms  "
+              f"p95 {st['iter_s_p95'] * 1e3:.2f}ms  "
+              f"p99 {st['iter_s_p99'] * 1e3:.2f}ms\n")
         if "loss_first" in agg:
             w(f"  loss               {agg['loss_first']} -> {agg['loss_last']}\n")
+    if agg.get("metric_hists"):
+        w("\n-- metrics percentiles (last metrics_snapshot) -----------------\n")
+        for name, h in sorted(agg["metric_hists"].items()):
+            p50 = h.get("p50")
+            p95 = h.get("p95")
+            p99 = h.get("p99")
+            if p50 is None:
+                continue
+            w(f"  {name:<24} x{h.get('count', 0):<6} p50 {p50 * 1e3:9.2f}ms  "
+              f"p95 {p95 * 1e3:9.2f}ms  p99 {p99 * 1e3:9.2f}ms\n")
     if agg["ckpt"]:
         w("\n-- checkpoint lifecycle ----------------------------------------\n")
         for eng, c in sorted(agg["ckpt"].items()):
@@ -255,6 +307,7 @@ def main(argv=None):
                 "segments": agg["segments"],
                 "totals": agg["totals"],
                 "steps": agg["steps"],
+                "metric_hists": agg["metric_hists"],
                 "ckpt": agg["ckpt"],
                 "data_stalls": agg["data_stalls"],
                 "preempt": agg["preempt"],

@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""traceview CLI — merge per-host telemetry shards into a Perfetto trace
+and run cross-host analysis (straggler attribution, step-time spikes,
+checkpoint-phase regression vs a baseline).
+
+Usage:
+    python tools/traceview.py host0.jsonl host1.jsonl --out trace.json
+    python tools/traceview.py shards/*.jsonl --baseline ckpt_phases.json
+
+All logic lives in ``pyrecover_tpu.telemetry.traceview``; this file is the
+executable shim so the tool is runnable before the package is installed.
+"""
+
+import sys
+from pathlib import Path
+
+# runnable from any cwd, installed or not
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from pyrecover_tpu.telemetry.traceview import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
