@@ -53,43 +53,33 @@ def _guard_against_dead_accelerator(timeout_s=120):
     forever with zero CPU (observed: `jax.devices()` blocking in the relay
     while the interpreter is otherwise live). A hung bench records nothing;
     a CPU-fallback bench records an honest JSON line with platform=cpu.
-    Probe device init in a SUBPROCESS with a timeout; if it never answers,
+    Probe device init in a SUBPROCESS with a hard timeout + one retry
+    (``telemetry.detectors.probe_accelerator``); if it never answers,
     re-exec this process with the accelerator plugin disabled and the
-    platform forced to cpu.
+    platform forced to cpu — carrying the probe's reason in
+    ``$PYRECOVER_PLATFORM_FALLBACK`` so the run is TAGGED as a fallback
+    (loud ``platform_fallback`` event, ``platform_fallback`` field in the
+    BENCH JSON, and ``--require-accelerator`` refuses to publish at all).
 
     Covers the hang-at-backend-init mode only: if the container's
     sitecustomize hangs EVERY fresh interpreter at startup (plugin
     registration blocking on the dead tunnel), no in-process guard can run
     — launch with ``env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu`` in
     that mode (see .claude/skills/verify/SKILL.md)."""
-    import subprocess
     import sys
-    import tempfile as _tf
 
     if os.environ.get("PYRECOVER_BENCH_NO_PROBE") == "1":
         return  # already re-exec'd (or probing explicitly disabled)
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         return  # platform already forced to cpu; nothing to probe
-    reason = None
-    # stderr to a FILE, not a pipe: a hung jax/axon stack can leave helper
-    # processes holding inherited pipe ends, and subprocess.run would then
-    # block in communicate() after killing the direct child — the exact
-    # no-output hang this guard exists to prevent
-    with _tf.TemporaryFile() as errf:
-        try:
-            probe = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; print(jax.device_count())"],
-                stdout=subprocess.DEVNULL, stderr=errf,
-                start_new_session=True, timeout=timeout_s,
-            )
-            if probe.returncode == 0:
-                return  # devices initialize fine; run normally
-            errf.seek(0)
-            tail = errf.read()[-500:].decode("utf-8", "replace")
-            reason = f"probe exited {probe.returncode}: ...{tail}"
-        except subprocess.TimeoutExpired:
-            reason = f"probe hung for {timeout_s}s (backend init deadlock)"
+    from pyrecover_tpu.telemetry.detectors import (
+        PLATFORM_FALLBACK_ENV,
+        probe_accelerator,
+    )
+
+    ok, reason = probe_accelerator(timeout_s=timeout_s, retries=1)
+    if ok:
+        return  # devices initialize fine; run normally
     print(
         f"bench: accelerator device init failed — {reason}; re-exec'ing on "
         "the CPU platform so a benchmark line is still recorded",
@@ -98,6 +88,7 @@ def _guard_against_dead_accelerator(timeout_s=120):
     env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
     env["JAX_PLATFORMS"] = "cpu"
     env["PYRECOVER_BENCH_NO_PROBE"] = "1"
+    env[PLATFORM_FALLBACK_ENV] = reason
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 
@@ -137,10 +128,43 @@ def main():
                     choices=["auto", "grouped", "einsum", "scatter"],
                     help="MoE dispatch backend (A/B the grouped ragged-GEMM "
                          "path against the r3 einsum/scatter backends)")
+    ap.add_argument("--require-accelerator", action="store_true",
+                    default=os.environ.get("BENCH_REQUIRE_ACCELERATOR") == "1",
+                    help="refuse to publish a number if the run resolved to "
+                         "CPU (probe fallback or otherwise): prints a null-"
+                         "value JSON line with the reason and exits 3, so a "
+                         "CPU run can never masquerade as an accelerator "
+                         "number (also via $BENCH_REQUIRE_ACCELERATOR=1)")
     args = ap.parse_args()
+
+    from pyrecover_tpu.telemetry import detectors
 
     n_devices = jax.device_count()
     platform = jax.devices()[0].platform
+    fallback_reason = os.environ.get(detectors.PLATFORM_FALLBACK_ENV)
+    if platform == "cpu" and fallback_reason:
+        # the probe degraded this run: say so loudly (WARNING + event when
+        # a sink is live) and tag every artifact below
+        detectors.emit_platform_fallback(fallback_reason, resolved=platform)
+    if platform == "cpu" and args.require_accelerator:
+        import sys
+
+        print(json.dumps({
+            "metric": "tokens_per_sec_per_chip",
+            "value": None,
+            "unit": "tok/s/chip",
+            "error": "refused: resolved platform is cpu but an accelerator "
+                     "was required",
+            "extra": {"platform": platform,
+                      "platform_fallback": fallback_reason},
+        }))
+        print(
+            "bench: refusing to present a CPU run as an accelerator number"
+            + (f" (fallback reason: {fallback_reason})" if fallback_reason
+               else ""),
+            file=sys.stderr,
+        )
+        return 3
     if platform == "cpu":
         # CI / no-accelerator fallback: shrink so the bench still runs
         args.model = "llama-150m"
@@ -269,6 +293,10 @@ def main():
         "model": args.model,
         "n_params": n_params,
         "platform": platform,
+        # non-null iff the accelerator probe degraded this run to CPU: a
+        # consumer comparing rounds must treat such a line as NOT
+        # comparable to accelerator rounds (ROADMAP item 5's r04/r05 bug)
+        "platform_fallback": fallback_reason,
         "n_devices": n_devices,
         "hbm_in_use_gb": hbm_gb,
         "seq_len": args.seq_len,
@@ -408,7 +436,10 @@ def main():
         "vs_baseline": round(mfu / reference_mfu, 3),
         "extra": extra,
     }))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    sys.exit(main())

@@ -109,4 +109,37 @@ else
   rc=1
 fi
 
+# doctor smoke: the crash-forensics gate (pyrecover_tpu/telemetry/doctor).
+# Classifies the chaos workdir's artifacts (postmortem bundles + telemetry
+# shards the soak just produced): the recovered main experiment must read
+# HEALTHY (its kill/resume history notwithstanding), and the hang drill
+# must read as a HANG wedged in the loader_wait phase. --expect makes a
+# misclassification exit 3; the JSON reports are then re-validated so an
+# unreadable/invalid report also fails the gate.
+DOCTOR_JSON="${DOCTOR_JSON:-/tmp/doctor_report.json}"
+DOCTOR_HANG_JSON="${DOCTOR_JSON%.json}_hang.json"
+if DR_OUT=$(JAX_PLATFORMS=cpu python tools/doctor.py "$CHAOS_WORK"/chaos \
+    --expect healthy --json "$DOCTOR_JSON" 2>&1); then
+  echo "$DR_OUT" | head -1
+else
+  echo "$DR_OUT"; rc=1
+fi
+if DR_OUT=$(JAX_PLATFORMS=cpu python tools/doctor.py "$CHAOS_WORK"/hang \
+    --expect hang --json "$DOCTOR_HANG_JSON" 2>&1); then
+  echo "$DR_OUT" | head -1
+else
+  echo "$DR_OUT"; rc=1
+fi
+python - "$DOCTOR_JSON" "$DOCTOR_HANG_JSON" <<'PYEOF' || rc=1
+import json, sys
+healthy = json.load(open(sys.argv[1]))
+hang = json.load(open(sys.argv[2]))
+assert healthy["classification"] == "healthy", healthy["classification"]
+assert hang["classification"] == "hang", hang["classification"]
+assert hang["phase"] == "loader_wait", hang["phase"]
+assert hang["evidence"]["n_bundles"] >= 1, "hang drill left no bundle"
+print("doctor: OK — chaos exp healthy; hang drill classified as hang in "
+      f"phase {hang['phase']} ({hang['evidence']['n_bundles']} bundle(s))")
+PYEOF
+
 exit $rc

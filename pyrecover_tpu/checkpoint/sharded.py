@@ -79,6 +79,7 @@ class ShardedCheckpointer:
             )
         # async saves: dispatch accepted (durability is wait()'s business);
         # sync saves: the directory is committed at this point
+        telemetry.watchdog.beat("ckpt_writer")
         faults.check("ckpt_commit", engine="sharded", path=str(path))
         if max_keep:
             # prune only already-finalized checkpoints; the in-flight save's
@@ -101,6 +102,7 @@ class ShardedCheckpointer:
                 metric="ckpt_sharded_durable_wait_s",
             ):
                 self._ckptr.wait_until_finished()
+            telemetry.watchdog.beat("ckpt_writer")
             # background seconds the training loop did NOT pay for: the gap
             # between dispatch (blocking_s) and durability shows up here
             # only when someone waits — final saves and shutdown

@@ -338,6 +338,10 @@ def _write_stream(path, leaves_iter, meta, verify, max_keep):
                 nonlocal written
                 io_retry(lambda: _write_once(b), op="write", path=path_s)
                 written += len(b)
+                # every landed chunk is checkpoint-writer progress for the
+                # run-health watchdog (no-op when none is active): a save
+                # that is WRITING is slow, not hung
+                telemetry.watchdog.beat("ckpt_writer")
                 if checksum is not None:
                     checksum.update(b)
 

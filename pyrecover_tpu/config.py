@@ -108,6 +108,17 @@ class TrainConfig:
     # seconds between metrics_snapshot flushes (counters/gauges/histogram
     # percentiles from telemetry/metrics.py); flushed at sync points only
     metrics_flush_interval_s: float = 30.0
+    # run-health watchdog (telemetry/watchdog.py): seconds of NO progress
+    # (train loop, loader workers, checkpoint writer all silent) before a
+    # hang_detected event + a flight-recorder bundle are written — the run
+    # is never killed. 0 disables. Monitoring starts after the first
+    # completed step, so first-step compile time cannot false-trip it.
+    hang_watchdog_timeout: float = 0.0
+    # implicit host-transfer detection around the jitted step dispatch
+    # (telemetry/detectors.py): "log" = jax.transfer_guard("log") over the
+    # hot loop (stderr only); "disallow" = per-dispatch guard that emits an
+    # implicit_transfer event and raises ImplicitTransferError
+    transfer_guard: str = "off"  # off | log | disallow
     profile: bool = False
     profile_step_start: int = 10
     profile_step_end: int = 12
@@ -309,6 +320,18 @@ def build_parser():
                    default=d.metrics_flush_interval_s,
                    help="Seconds between metrics_snapshot telemetry events "
                         "(step-time/loader/ckpt-phase percentiles).")
+    p.add_argument("--hang-watchdog-timeout", type=float,
+                   dest="hang_watchdog_timeout",
+                   default=d.hang_watchdog_timeout,
+                   help="Seconds of no progress (train loop, loader, "
+                        "checkpoint writer) before the run-health watchdog "
+                        "emits hang_detected and writes a postmortem "
+                        "bundle (never kills the run). 0 disables.")
+    p.add_argument("--transfer-guard", type=str, default=d.transfer_guard,
+                   choices=["off", "log", "disallow"],
+                   help="Implicit host-transfer detection: log (stderr via "
+                        "jax.transfer_guard) or disallow (implicit_transfer "
+                        "telemetry event + typed error on violation).")
     p.add_argument("--profile", action="store_true")
     p.add_argument("--profile-step-start", type=int, default=d.profile_step_start)
     p.add_argument("--profile-step-end", type=int, default=d.profile_step_end)
@@ -384,6 +407,8 @@ def get_args(argv=None):
         telemetry_path=ns.telemetry_path,
         telemetry_stdout=ns.telemetry_stdout,
         metrics_flush_interval_s=ns.metrics_flush_interval_s,
+        hang_watchdog_timeout=ns.hang_watchdog_timeout,
+        transfer_guard=ns.transfer_guard,
         profile=ns.profile,
         profile_step_start=ns.profile_step_start,
         profile_step_end=ns.profile_step_end,

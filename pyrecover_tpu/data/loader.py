@@ -88,6 +88,9 @@ class DataLoader:
         local = self._local_indices(global_indices)
         items = [self.dataset[i] for i in local]
         batch = collate_clm(items, self.pad_token_id)
+        # a completed batch is loader progress: feeds the run-health
+        # watchdog's no-progress window (no-op when none is active)
+        telemetry.watchdog.beat("loader")
         return batch
 
     def _to_device(self, batch):
@@ -156,8 +159,17 @@ class DataLoader:
             except queue.Empty:
                 # the prefetch queue ran dry: the consumer (the train loop)
                 # is now stalled on host-side tokenize/collate — the exact
-                # signal that says "add workers / deepen prefetch"
+                # signal that says "add workers / deepen prefetch". A REAL
+                # (begin/end) span, not a retroactive one: while the wait
+                # is in flight the open `loader_wait` span is what the
+                # flight recorder's ring shows, so a hang bundle taken
+                # mid-stall names this phase. The begin event costs one
+                # emit on a path that is already stalled.
                 t0 = time.monotonic()
+                wait_span = telemetry.spans.begin(
+                    "loader_wait", batch=self.batches_served + 1,
+                    metric="loader_wait_s",
+                )
                 try:
                     item = self._queue.get(
                         timeout=self.stall_timeout or None
@@ -168,11 +180,7 @@ class DataLoader:
                     waited = time.monotonic() - t0
                     self.stall_count += 1
                     self.stall_s += waited
-                    telemetry.record_span(
-                        "loader_wait", t0, t0 + waited, timeout=True,
-                        batch=self.batches_served + 1,
-                        metric="loader_wait_s",
-                    )
+                    wait_span.end(ok=False, error="LoaderStallError")
                     telemetry.emit(
                         "loader_stall_timeout", wait_s=round(waited, 3),
                         timeout_s=self.stall_timeout,
@@ -186,14 +194,7 @@ class DataLoader:
                 waited = time.monotonic() - t0
                 self.stall_count += 1
                 self.stall_s += waited
-                # the wait is a trace slice AND a histogram sample: the
-                # trace shows WHICH batch stalled, the percentiles show
-                # how often (span written after the fact — the wait
-                # itself never pays the event I/O)
-                telemetry.record_span(
-                    "loader_wait", t0, t0 + waited,
-                    batch=self.batches_served + 1, metric="loader_wait_s",
-                )
+                wait_span.end()
                 if waited >= _STALL_EVENT_THRESHOLD_S:
                     telemetry.emit(
                         "data_stall", wait_s=round(waited, 6),

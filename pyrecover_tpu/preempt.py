@@ -180,6 +180,13 @@ class PreemptionWatcher:
         )
         try:
             write_requeue_marker(exp_dir, done=False, step=step)
+            # black-box bundle on the way out: os._exit skips every other
+            # teardown path, so this is the postmortem's only chance to
+            # capture the ring + all-thread stacks (what was mid-save?)
+            telemetry.flight.dump(
+                "preempt_escalation", signal=int(signum),
+                signal_count=self.signal_count, escalation_step=step,
+            )
         finally:
             self._exit_fn(75)  # EX_TEMPFAIL: retryable, the launcher requeues
 

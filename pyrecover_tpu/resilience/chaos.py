@@ -19,6 +19,11 @@ A smoke soak is four trainer runs over one experiment directory::
                at s2, then the *final* checkpoint's bytes flipped
     cycle 4  : resume, no faults: quarantines the corrupt checkpoint,
                falls back to the newest good one, finishes, DONE marker
+    cycle 5  : hang drill in its own exp dir — a seeded loader_stall wedges
+               the prefetch pipeline past the run-health watchdog window;
+               the run survives, but hang_detected + a postmortem bundle
+               must appear and `doctor` must classify a hang wedged in
+               the loader_wait phase
 
 Verdicts: per-cycle exit codes, stitched CSV == golden CSV, exactly the
 injected corruption quarantined (zero non-injected losses), and the
@@ -39,7 +44,8 @@ import time
 from pathlib import Path
 
 from pyrecover_tpu.resilience.quarantine import list_quarantined
-from pyrecover_tpu.telemetry import read_events
+from pyrecover_tpu.telemetry import flight, read_events
+from pyrecover_tpu.telemetry import doctor as doctor_mod
 
 CHAOS_JSON_ENV = "CHAOS_JSON"
 
@@ -49,7 +55,8 @@ _TINY_MODEL_ARGS = (
 )
 
 PRESETS = {
-    # CI-speed: 2 fault kinds per kill cycle, tiny model, CPU, ~4 runs
+    # CI-speed: 2 fault kinds per kill cycle, tiny model, CPU, ~6 runs
+    # (golden + 4 kill/corrupt/resume cycles + the hang drill)
     "smoke": dict(
         training_steps=10, checkpoint_frequency=3, batch_size=8,
         sequence_length=32, training_samples=64, run_timeout_s=240,
@@ -62,7 +69,8 @@ PRESETS = {
 }
 
 
-def _trainer_cmd(preset, exp, seed, workdir, *, resume=False):
+def _trainer_cmd(preset, exp, seed, workdir, *, resume=False,
+                 extra_args=()):
     cmd = [
         sys.executable, "-m", "pyrecover_tpu.train",
         "--training-steps", str(preset["training_steps"]),
@@ -86,6 +94,7 @@ def _trainer_cmd(preset, exp, seed, workdir, *, resume=False):
     ]
     if resume:
         cmd += ["--resume-from-checkpoint", "latest"]
+    cmd += list(extra_args)
     return cmd
 
 
@@ -148,8 +157,10 @@ def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
     violations = []
     cycles = []
 
-    def cycle(name, *, fault_plan, resume, expect_rc, exp="chaos"):
-        cmd = _trainer_cmd(preset, exp, seed, workdir, resume=resume)
+    def cycle(name, *, fault_plan, resume, expect_rc, exp="chaos",
+              extra_args=()):
+        cmd = _trainer_cmd(preset, exp, seed, workdir, resume=resume,
+                           extra_args=extra_args)
         try:
             rc, secs = _run_trainer(
                 cmd, fault_plan=fault_plan, log_path=log_path,
@@ -199,6 +210,24 @@ def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
     # fall back to the newest good one, and finish the full step budget
     cycle("recover_and_finish", resume=True, expect_rc=(0,),
           fault_plan=None)
+
+    # cycle 5 — hang drill (own exp dir; continuity gates untouched): a
+    # seeded loader_stall wedges one producer worker long past the
+    # run-health watchdog's window. The run must NOT die — the watchdog's
+    # contract is forensics, never a kill — but hang_detected must fire, a
+    # postmortem bundle must land in .postmortem/, and doctor must read
+    # the artifacts as a hang wedged in the loader_wait phase. The stall
+    # hits producer batch 9: the prefetch pipeline materializes ~6 batches
+    # ahead, so the sleep starts AFTER first-step compile (the watchdog
+    # only arms post-compile) and the window has stall time to measure.
+    cycle("hang_watchdog", resume=False, expect_rc=(0,), exp="hang",
+          extra_args=("--hang-watchdog-timeout", "5"),
+          fault_plan={
+              "seed": seed,
+              "faults": [
+                  {"type": "loader_stall", "seconds": 20.0, "batch": 9},
+              ],
+          })
 
     exp_dir = workdir / "chaos"
     golden_rows = _read_csv_rows(
@@ -259,6 +288,34 @@ def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
             "telemetry JSONL never rotated despite the soak's byte cap"
         )
 
+    # hang drill verdicts: watchdog fired, bundle landed, doctor reads it
+    hang_dir = workdir / "hang"
+    hang_events = read_events(hang_dir / "hang_telemetry.jsonl")
+    hang_hits = [e for e in hang_events if e["event"] == "hang_detected"]
+    if not hang_hits:
+        violations.append(
+            "hang drill: no hang_detected event despite a 20s loader stall "
+            "against a 5s watchdog window"
+        )
+    hang_bundles = flight.list_bundles(hang_dir)
+    if not hang_bundles:
+        violations.append("hang drill: no postmortem bundle in .postmortem/")
+    hang_doctor = doctor_mod.diagnose(hang_dir)
+    if hang_doctor["classification"] != "hang":
+        violations.append(
+            "hang drill: doctor classified "
+            f"{hang_doctor['classification']!r}, expected 'hang'"
+        )
+    elif hang_doctor.get("phase") != "loader_wait":
+        violations.append(
+            "hang drill: doctor named phase "
+            f"{hang_doctor.get('phase')!r}, expected 'loader_wait'"
+        )
+    if not any(e["event"] == "flight_dump" for e in hang_events):
+        violations.append(
+            "hang drill: no flight_dump event in the telemetry stream"
+        )
+
     report = {
         "preset": preset_name,
         "seed": seed,
@@ -275,6 +332,12 @@ def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
         "first_divergence": first_divergence,
         "rows": len(stitched_rows),
         "quarantined": quarantined,
+        "hang": {
+            "hang_detected": len(hang_hits),
+            "bundles": [Path(b).name for b in hang_bundles],
+            "doctor_classification": hang_doctor["classification"],
+            "doctor_phase": hang_doctor.get("phase"),
+        },
         "telemetry_rotated_shards": rotated,
         "telemetry_counts": {
             k: counts.get(k, 0)

@@ -37,6 +37,17 @@ Core event names across the stack (fields beyond the envelope):
     loader_stall_timeout  wait_s, timeout_s, batch (stall watchdog tripped)
     fault_injected    type, site, ... (resilience.faults fired an injection)
     mfu_peak_unknown  device_kind, fallback_flops
+    hang_detected     silent_s, window_s, sources{} (run-health watchdog:
+                      no heartbeat progress for a full window)
+    flight_dump       reason, path, last_step (a postmortem bundle was
+                      written under <exp_dir>/.postmortem/)
+    recompile         fn, count, changed (train-step signature drift — a
+                      genuine retrace; recompile_total counter rides along)
+    implicit_transfer fn, step, error (jax.transfer_guard tripped inside
+                      the dispatch under --transfer-guard disallow)
+    platform_fallback reason, resolved, expected (run is on CPU when an
+                      accelerator was expected — perf numbers are not
+                      accelerator numbers)
     spec_axis_dropped axis, mesh_axes (a sharding spec named a missing axis)
     ckpt_manifest_dtype_drift  path, detail (resume will cast the leaf)
     run_summary       status, step, + WallTimeTotals.as_dict() (goodput)
@@ -54,9 +65,18 @@ report; ``tools/traceview.py`` merges multi-host shards into a
 Perfetto-loadable Chrome trace + straggler/spike/regression analysis;
 ``sinks.read_events`` is the tolerant (rotation-aware) read-back both
 build on.
+
+Failure-time half (``flight.py`` / ``watchdog.py`` / ``detectors.py`` /
+``doctor.py``; README "Crash forensics & run health"): an always-on
+in-memory ring of recent events + open spans, black-box postmortem
+bundles under ``<exp_dir>/.postmortem/`` (unhandled exceptions, fatal
+signals, SIGTERM escalation, watchdog hangs, explicit ``flight.dump``),
+silent-failure detectors (recompile / implicit transfer / platform
+fallback / HBM gauges), and the ``doctor`` CLI that classifies a dead
+run from those artifacts.
 """
 
-from pyrecover_tpu.telemetry import metrics, spans
+from pyrecover_tpu.telemetry import flight, metrics, spans, watchdog
 from pyrecover_tpu.telemetry.bus import (
     add_sink,
     close,
@@ -90,4 +110,6 @@ __all__ = [
     "record_span",
     "spans",
     "metrics",
+    "flight",
+    "watchdog",
 ]

@@ -1,0 +1,425 @@
+"""``doctor`` — classify why a run died (or silently degraded) from artifacts.
+
+Input: a postmortem bundle, an experiment directory (telemetry JSONL +
+``.postmortem/`` + REQUEUE/DONE markers), or a bare telemetry JSONL.
+Output: one classification —
+
+    healthy           finished (or cleanly stopped) with no detector hits
+    hang              the run-health watchdog saw a no-progress window
+    crash             unhandled exception, fatal signal, or a stream that
+                      ends without a run_summary (hard kill)
+    preemption        deadline/notice stop or the SIGTERM-escalation exit
+    oom               the crash is a memory exhaustion (exception text or
+                      HBM peak at/over budget)
+    platform_fallback the run executed on CPU when an accelerator was
+                      expected (probe fallback / $PYRECOVER_EXPECT_ACCELERATOR)
+    recompile_storm   repeated train-step retraces silently ate throughput
+    unknown           no readable evidence
+
+— plus the PHASE the run was in, named from the spans still open at death
+(bundle ``open_spans.json``, else unpaired ``span_begin`` events at the
+end of the stream): ``loader_wait``, ``ckpt_write``, ``eval``, ``resume``…
+
+Only the LAST run segment (after the newest ``run_start``) drives the
+classification — an interrupt/resume chain carries earlier kills by
+design; what matters is how the newest attempt ended. Earlier-segment
+signals surface as findings, not the verdict.
+
+Exit codes: 0 healthy · 1 a failure class was identified · 2 no evidence
+· 3 ``--expect CLASS`` given and the classification differs (the CI-gate
+mode). Pure stdlib + the telemetry read-back — no jax, runs anywhere.
+"""
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+from pyrecover_tpu.telemetry import flight
+from pyrecover_tpu.telemetry.sinks import read_events
+
+CLASSES = (
+    "healthy", "hang", "crash", "preemption", "oom", "platform_fallback",
+    "recompile_storm", "unknown",
+)
+
+DEFAULT_RECOMPILE_STORM = 3
+
+_OOM_RE = re.compile(
+    r"RESOURCE_EXHAUSTED|out of memory|OutOfMemory|\bOOM\b|MemoryError"
+    r"|[Aa]llocat\w* .{0,40}(failed|exhausted)",
+)
+
+
+# ---- evidence gathering -----------------------------------------------------
+
+def _find_telemetry(root):
+    """The base (un-rotated) telemetry JSONL under an experiment dir."""
+    cands = sorted(root.glob("*telemetry*.jsonl")) or sorted(
+        p for p in root.glob("*.jsonl") if not p.name.startswith(".")
+    )
+    return cands[0] if cands else None
+
+
+def _read_marker(root):
+    for name, done in (("DONE", True), ("REQUEUE", False)):
+        p = root / name
+        if p.exists():
+            try:
+                payload = json.loads(p.read_text())
+                if isinstance(payload, dict):
+                    payload.setdefault("done", done)
+                    return payload
+            except (OSError, ValueError):
+                pass
+            return {"done": done}
+    return None
+
+
+def _load_bundle(path):
+    out = {"path": str(path), "manifest": {}, "open_spans": []}
+    try:
+        out["manifest"] = json.loads((path / flight.MANIFEST_NAME).read_text())
+    except (OSError, ValueError):
+        return None
+    try:
+        out["open_spans"] = json.loads((path / "open_spans.json").read_text())
+    except (OSError, ValueError):
+        pass
+    return out
+
+
+def gather(target):
+    """Collect every readable artifact for ``target`` into one evidence
+    dict (``None`` values where an artifact is absent)."""
+    target = Path(target)
+    ev = {
+        "source": str(target),
+        "telemetry_path": None,
+        "events": [],
+        "bundles": [],
+        "fatal_stacks": False,
+        "marker": None,
+    }
+    if target.is_file():  # a bare telemetry JSONL
+        ev["telemetry_path"] = str(target)
+        ev["events"] = read_events(target)
+        root = target.parent
+    else:
+        root = target
+        if (target / flight.MANIFEST_NAME).is_file():  # a single bundle
+            root = target.parent.parent  # bundle -> .postmortem -> exp_dir
+        elif target.name == flight.POSTMORTEM_DIRNAME:
+            root = target.parent
+        tele = _find_telemetry(root)
+        if tele is not None:
+            ev["telemetry_path"] = str(tele)
+            ev["events"] = read_events(tele)
+    bundles = [b for p in (target, root) for b in flight.list_bundles(p)]
+    seen = set()
+    ev["bundles"] = [
+        b for b in bundles
+        if not (str(b) in seen or seen.add(str(b)))
+    ]
+    fatal_root = root / flight.POSTMORTEM_DIRNAME
+    try:
+        stem = flight.FATAL_STACKS_NAME.rsplit(".", 1)[0]
+        ev["fatal_stacks"] = any(
+            p.is_file() and p.stat().st_size > 0
+            for p in fatal_root.glob(stem + "*")
+        )
+    except OSError:
+        pass
+    if root.is_dir():
+        ev["marker"] = _read_marker(root)
+    return ev
+
+
+# ---- last-segment analysis --------------------------------------------------
+
+def _last_segment(events):
+    start = 0
+    for i, e in enumerate(events):
+        if e.get("event") == "run_start":
+            start = i
+    return events[start:]
+
+
+def _open_span_stack(events):
+    """Names of span_begin events never matched by a span_end, ordered
+    outermost→innermost (span ids are process-monotonic)."""
+    open_ = {}
+    for e in events:
+        name = e.get("event")
+        if name == "span_begin":
+            open_[e.get("span")] = e
+        elif name == "span_end":
+            open_.pop(e.get("span"), None)
+    ordered = sorted(open_.values(), key=lambda r: r.get("span") or 0)
+    return [r.get("name", "?") for r in ordered]
+
+
+def analyze(evidence, *, recompile_storm_threshold=DEFAULT_RECOMPILE_STORM):
+    """Classify. Returns the report dict (see module docstring)."""
+    events = evidence["events"]
+    bundles = [
+        b for b in (
+            _load_bundle(Path(p)) for p in evidence["bundles"]
+        ) if b is not None
+    ]
+    newest_bundle = bundles[-1] if bundles else None
+    seg = _last_segment(events)
+    counts = {}
+    for e in seg:
+        counts[e.get("event")] = counts.get(e.get("event"), 0) + 1
+    summary = next(
+        (e for e in reversed(seg) if e.get("event") == "run_summary"), None
+    )
+    findings = []
+
+    def finding(kind, detail):
+        findings.append({"kind": kind, "detail": detail})
+
+    # -- phase: open spans at death ------------------------------------------
+    phase_stack = []
+    if newest_bundle and newest_bundle["open_spans"]:
+        phase_stack = [
+            r.get("name", "?") for r in newest_bundle["open_spans"]
+        ]
+    elif summary is None and seg:
+        phase_stack = _open_span_stack(seg)
+    phase = phase_stack[-1] if phase_stack else None
+
+    # -- evidence-derived findings -------------------------------------------
+    exc_texts = []
+    for b in bundles:
+        man = b["manifest"]
+        exc = man.get("exception") or {}
+        if exc:
+            exc_texts.append(
+                f"{exc.get('type', '?')}: {exc.get('message', '')}"
+            )
+        finding("bundle", f"{man.get('reason', '?')} at {b['path']}")
+    if summary is not None and summary.get("status") == "error":
+        finding("run_summary", f"status=error at step {summary.get('step')}")
+    n_recompiles = counts.get("recompile", 0)
+    if n_recompiles:
+        finding("recompile", f"{n_recompiles} train-step retrace(s)")
+    n_transfers = counts.get("implicit_transfer", 0)
+    if n_transfers:
+        finding("implicit_transfer", f"{n_transfers} implicit transfer(s)")
+    n_fallback = counts.get("platform_fallback", 0)
+    for e in seg:
+        if e.get("event") == "platform_fallback":
+            finding("platform_fallback", e.get("reason", ""))
+    n_hangs = counts.get("hang_detected", 0)
+    if n_hangs:
+        silences = [
+            e.get("silent_s") for e in seg
+            if e.get("event") == "hang_detected"
+        ]
+        finding(
+            "hang_detected",
+            f"{n_hangs} no-progress window(s), max silence "
+            f"{max(s for s in silences if s is not None):.1f}s",
+        )
+    earlier = len(events) - len(seg)
+    if earlier:
+        finding("earlier_segments", f"{earlier} event(s) from prior attempts")
+
+    # -- classification (most-specific first) --------------------------------
+    bundle_reason = (
+        (newest_bundle or {}).get("manifest", {}).get("reason", "")
+    )
+    oom_text = next(
+        (t for t in exc_texts if _OOM_RE.search(t)), None
+    )
+    hbm_pct = (summary or {}).get("hbm_peak_pct")
+    detail = ""
+    if oom_text or (
+        isinstance(hbm_pct, (int, float)) and hbm_pct >= 100.0
+    ):
+        cls = "oom"
+        detail = oom_text or f"HBM peak at {hbm_pct}% of budget"
+    elif n_hangs or bundle_reason == "hang_detected":
+        cls = "hang"
+        detail = (
+            "watchdog saw a no-progress window"
+            + (
+                "; the run later resumed and "
+                + str((summary or {}).get("status"))
+                if summary is not None else "; no run_summary followed"
+            )
+        )
+    elif (
+        counts.get("preempt_signal_escalation")
+        or bundle_reason == "preempt_escalation"
+        or counts.get("preempt_stop")
+        or (summary is not None and summary.get("status") == "stopped_early")
+    ):
+        cls = "preemption"
+        if counts.get("preempt_signal_escalation") or (
+            bundle_reason == "preempt_escalation"
+        ):
+            detail = "second signal mid-save: escalated to immediate exit"
+        else:
+            detail = next(
+                (e.get("reason", "") for e in reversed(seg)
+                 if e.get("event") == "preempt_stop"),
+                "stopped early for a final checkpoint",
+            )
+    elif (
+        (summary is not None and summary.get("status") == "error")
+        or bundle_reason in ("unhandled_exception", "thread_exception")
+        or evidence["fatal_stacks"]
+        or (summary is None and seg)
+    ):
+        cls = "crash"
+        if exc_texts:
+            detail = exc_texts[-1][:300]
+        elif evidence["fatal_stacks"]:
+            detail = "fatal signal (see .postmortem/fatal_signal_stacks.txt)"
+        elif summary is None:
+            detail = (
+                "event stream ends without a run_summary — hard kill "
+                "(SIGKILL/power loss) or the run is still in flight"
+            )
+    elif n_fallback:
+        cls = "platform_fallback"
+        detail = next(
+            (e.get("reason", "") for e in seg
+             if e.get("event") == "platform_fallback"), "",
+        )
+    elif n_recompiles >= recompile_storm_threshold:
+        cls = "recompile_storm"
+        detail = (
+            f"{n_recompiles} retraces (threshold "
+            f"{recompile_storm_threshold}) — shape/dtype drift is eating "
+            "compile time"
+        )
+    elif summary is not None or (evidence["marker"] or {}).get("done"):
+        cls = "healthy"
+        detail = (
+            f"status={summary.get('status')} at step {summary.get('step')}"
+            if summary is not None else "DONE marker present"
+        )
+    else:
+        cls = "unknown"
+        detail = "no run_summary, no bundle, no marker — nothing to read"
+
+    last_step = None
+    if summary is not None:
+        last_step = summary.get("step")
+    elif newest_bundle:
+        last_step = newest_bundle["manifest"].get("last_step")
+
+    return {
+        "classification": cls,
+        "phase": phase,
+        "phase_stack": phase_stack,
+        "detail": detail,
+        "last_step": last_step,
+        "findings": findings,
+        "evidence": {
+            "source": evidence["source"],
+            "telemetry_path": evidence["telemetry_path"],
+            "n_events": len(events),
+            "n_last_segment_events": len(seg),
+            "n_bundles": len(bundles),
+            "fatal_stacks": evidence["fatal_stacks"],
+            "marker_done": (evidence["marker"] or {}).get("done"),
+            "recompiles": n_recompiles,
+            "implicit_transfers": n_transfers,
+            "platform_fallbacks": n_fallback,
+            "hangs": n_hangs,
+            "last_status": (summary or {}).get("status"),
+        },
+    }
+
+
+def diagnose(target, *, recompile_storm_threshold=DEFAULT_RECOMPILE_STORM):
+    """gather + analyze in one call (the API chaos and tests use)."""
+    return analyze(
+        gather(target),
+        recompile_storm_threshold=recompile_storm_threshold,
+    )
+
+
+def exit_code(report):
+    if report["classification"] == "healthy":
+        return 0
+    if report["classification"] == "unknown":
+        return 2
+    return 1
+
+
+# ---- rendering / CLI --------------------------------------------------------
+
+def render(report, out=None):
+    w = (out or sys.stdout).write
+    cls = report["classification"]
+    w(f"doctor: {cls.upper()}")
+    if report["phase"]:
+        w(f" in phase [{report['phase']}]")
+    if report["last_step"] is not None:
+        w(f" at step {report['last_step']}")
+    w("\n")
+    if report["detail"]:
+        w(f"  {report['detail']}\n")
+    if report["phase_stack"] and len(report["phase_stack"]) > 1:
+        w(f"  open spans: {' > '.join(report['phase_stack'])}\n")
+    e = report["evidence"]
+    w(
+        f"  evidence: {e['n_events']} events "
+        f"({e['n_last_segment_events']} in the last segment), "
+        f"{e['n_bundles']} bundle(s), "
+        f"last status {e['last_status']}\n"
+    )
+    for f in report["findings"]:
+        w(f"  - {f['kind']}: {f['detail']}\n")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="classify why a pyrecover run died (hang / crash / "
+        "preemption / OOM / platform fallback / recompile storm) from its "
+        "postmortem bundle or telemetry stream",
+    )
+    p.add_argument(
+        "path",
+        help="a postmortem bundle, a .postmortem dir, an experiment dir, "
+        "or a telemetry JSONL",
+    )
+    p.add_argument("--json", dest="json_out", default=None,
+                   help="also write the report as JSON here")
+    p.add_argument("--recompile-storm-threshold", type=int,
+                   default=DEFAULT_RECOMPILE_STORM)
+    p.add_argument(
+        "--expect", choices=CLASSES, default=None,
+        help="CI-gate mode: exit 0 iff the classification matches, 3 "
+        "otherwise",
+    )
+    args = p.parse_args(argv)
+
+    report = diagnose(
+        args.path,
+        recompile_storm_threshold=args.recompile_storm_threshold,
+    )
+    render(report)
+    if args.json_out:
+        Path(args.json_out).parent.mkdir(parents=True, exist_ok=True)
+        Path(args.json_out).write_text(json.dumps(report, indent=2))
+    if args.expect is not None:
+        if report["classification"] != args.expect:
+            print(
+                f"doctor: expected classification {args.expect!r}, got "
+                f"{report['classification']!r}", file=sys.stderr,
+            )
+            return 3
+        return 0
+    return exit_code(report)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,6 +9,7 @@ profiling windows keep 1:1 capability parity (call-stack map in SURVEY §3.1).
 Run:  python -m pyrecover_tpu.train --training-steps 100 ...
 """
 
+import contextlib
 import dataclasses
 import sys
 import time
@@ -19,6 +20,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from pyrecover_tpu import telemetry
+from pyrecover_tpu.telemetry import detectors
 from pyrecover_tpu.checkpoint import (
     ShardedCheckpointer,
     checkpoint_path,
@@ -385,15 +387,28 @@ def train(config: TrainConfig):
         return _train_impl(config, totals, t_entry, owned_sinks, status)
     finally:
         totals.wall_s = time.monotonic() - t_entry
+        # black-box dump FIRST while unwinding an error: the bundle must
+        # capture the ring/open spans before teardown, and dumping here
+        # (not just in sys.excepthook) means a caller catching the
+        # exception around train() cannot swallow the postmortem
+        exc = sys.exc_info()
+        if exc[0] is not None and not issubclass(
+            exc[0], (KeyboardInterrupt, SystemExit)
+        ):
+            telemetry.flight.dump("unhandled_exception", exc=exc)
         # final percentile snapshot first: the run_summary consumer gets
         # goodput AND the step-time/ckpt-phase distributions in one stream
         telemetry.metrics.flush(reason="run_end")
         telemetry.emit(
             "run_summary", status=status["status"], step=status["step"],
             **totals.as_dict(),
+            # peak HBM vs the device budget (empty off-accelerator): the
+            # silent-creep-toward-OOM detector's run-level verdict
+            **detectors.hbm_run_summary(),
         )
         for sink in owned_sinks:
             telemetry.remove_sink(sink)
+        telemetry.flight.uninstall()
 
 
 def _train_impl(config, totals, t_entry, owned_sinks, status):
@@ -431,6 +446,13 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
     log_host0("Model: %.2fM params | %s", n_params / 1e6, model_config)
 
     exp_dir = checkpoint_path(config.checkpoint_dir, config.experiment_name, 0).parent
+
+    # ---- flight recorder (always on, --telemetry or not) -------------------
+    # the in-memory ring + black-box dump hooks: unhandled exceptions,
+    # fatal signals (faulthandler), the SIGTERM-escalation path, and the
+    # hang watchdog all write a postmortem bundle under .postmortem/
+    detectors.reset_hbm()
+    telemetry.flight.install(exp_dir, config=dataclasses.asdict(config))
 
     # ---- telemetry sinks + previous attempt's progress high-water mark -----
     # prior_step: the highest step the PREVIOUS attempt completed, recovered
@@ -474,6 +496,9 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
         training_steps=config.training_steps,
         resume=resume_requested,
     )
+    # loud platform_fallback when an accelerator was expected but jax
+    # resolved cpu (probe fallback marker / $PYRECOVER_EXPECT_ACCELERATOR)
+    detectors.check_expected_accelerator()
 
     sharded_ckptr = (
         ShardedCheckpointer(use_async=config.async_checkpoint)
@@ -599,6 +624,24 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
     run_eval = None
     watcher = None
     csv_logger = None
+    # run-health watchdog: created now, STARTED only after the first
+    # completed step of this attempt — the first step carries jit compile,
+    # an arbitrarily long legitimate silence (init-time deadlocks are the
+    # accelerator probe's job, not this watchdog's)
+    hang_watchdog = (
+        telemetry.watchdog.Watchdog(config.hang_watchdog_timeout)
+        if config.hang_watchdog_timeout > 0 else None
+    )
+    # per-dispatch implicit-transfer guard (events + typed error); "log"
+    # mode instead wraps the whole loop in jax's stderr-logging guard
+    dispatch_watch = (
+        detectors.transfer_watch if config.transfer_guard == "disallow"
+        else None
+    )
+    loop_guard = (
+        jax.transfer_guard("log") if config.transfer_guard == "log"
+        else contextlib.nullcontext()
+    )
     pending_losses = []  # (step, loss device scalar) for the CSV
 
     def flush_csv():
@@ -617,6 +660,10 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
             model_config, optimizer, loss_chunk_size=config.loss_chunk_size,
             grad_accumulation_steps=config.grad_accumulation_steps,
         )
+        # recompile detector: an abstract-signature change on the jitted
+        # step is a genuine retrace — one `recompile` event per drift, so
+        # a recompile storm can't silently eat throughput
+        step_fn = detectors.RecompileWatch(step_fn, name="train_step")
         # MFU/TFLOPs use the reference's 6N convention: token embedding
         # excluded (ref train.py:126-127), untied output projection kept.
         meter = ThroughputMeter(
@@ -696,7 +743,7 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
             steps_since_sync = 0
             return dt, n
 
-        with jax.sharding.set_mesh(mesh):
+        with loop_guard, jax.sharding.set_mesh(mesh):
             while step < config.training_steps:
                 if (
                     config.profile
@@ -718,10 +765,18 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
                 iter_t0 = time.monotonic()
                 epoch, batch = next(loader)
                 t_data = time.monotonic()
-                state, metrics = step_fn(state, batch)
+                if dispatch_watch is None:
+                    state, metrics = step_fn(state, batch)
+                else:
+                    with dispatch_watch(step=step + 1):
+                        state, metrics = step_fn(state, batch)
                 t_dispatch = time.monotonic()
                 step += 1
                 steps_since_sync += 1
+                if hang_watchdog is not None:
+                    hang_watchdog.beat("train_loop")
+                    if not hang_watchdog.started:
+                        hang_watchdog.start()  # first step done: compile over
                 if telemetry.enabled():
                     # host-side timestamps only; under async dispatch
                     # dispatch_s is the enqueue cost, not device time —
@@ -765,6 +820,10 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
                     telemetry.metrics.histogram("step_iter_s").observe(
                         dt / n, n=n
                     )
+                    # periodic HBM gauge sample (no-op where the backend
+                    # exposes no memory_stats, i.e. CPU) — flushed with the
+                    # metrics_snapshot below, peak folded into run_summary
+                    detectors.sample_hbm()
                     telemetry.metrics.maybe_flush(
                         interval_s=config.metrics_flush_interval_s
                     )
@@ -839,6 +898,9 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
     finally:
         status["step"] = step  # crashed runs still report how far they got
         unwinding = sys.exc_info()[0] is not None
+        if hang_watchdog is not None:
+            hang_watchdog.stop()
+        detectors.sample_hbm()  # final peak sample for run_summary
         if profiling:
             jax.profiler.stop_trace()
             prof_span.end()

@@ -265,7 +265,9 @@ def test_loader_without_watchdog_still_blocks_and_serves():
 
 def test_second_signal_during_save_escalates(tmp_path, mem_sink):
     from pyrecover_tpu.preempt import REQUEUE_MARKER, PreemptionWatcher
+    from pyrecover_tpu.telemetry import flight
 
+    flight.install(tmp_path, enable_faulthandler=False)
     w = PreemptionWatcher(enabled=True, job_end_time=None)
     w.install_signal_handler()
     exits = []
@@ -280,7 +282,15 @@ def test_second_signal_during_save_escalates(tmp_path, mem_sink):
         assert marker["step"] == 42 and marker["done"] is False
         esc = events(mem_sink, "preempt_signal_escalation")
         assert len(esc) == 1 and esc[0]["count"] == 2
+        # the escalation's last act is a black-box bundle: os._exit skips
+        # every other teardown, so this is the postmortem's only record
+        bundles = flight.list_bundles(tmp_path)
+        assert len(bundles) == 1
+        manifest = json.loads((bundles[0] / "MANIFEST.json").read_text())
+        assert manifest["reason"] == "preempt_escalation"
+        assert manifest["escalation_step"] == 42
     finally:
+        flight.uninstall()
         signal.signal(signal.SIGTERM, signal.SIG_DFL)
         signal.signal(signal.SIGUSR1, signal.SIG_DFL)
 
@@ -370,4 +380,11 @@ def test_chaos_smoke_soak_bitexact(tmp_path):
     assert counts["fault_injected"] >= 4
     # the recovery run fell back: precheck failure recorded, then a resume
     assert counts["ckpt_precheck_failed"] >= 1 and counts["resume"] >= 2
+    # ISSUE 6 hang drill: the watchdog fired under the seeded loader
+    # stall, a postmortem bundle landed, and doctor read the artifacts as
+    # a hang wedged in the loader_wait phase
+    assert report["hang"]["hang_detected"] >= 1
+    assert report["hang"]["bundles"]
+    assert report["hang"]["doctor_classification"] == "hang"
+    assert report["hang"]["doctor_phase"] == "loader_wait"
     assert (tmp_path / "report.json").exists()

@@ -1,0 +1,244 @@
+"""Doctor classification tests (pyrecover_tpu/telemetry/doctor.py).
+
+The classification table — healthy / hang / crash / preemption / oom /
+platform_fallback / recompile_storm / unknown — over synthetic telemetry
+streams and real flight bundles, phase naming from open spans, the
+last-segment-wins rule, exit codes, and the CLI (--json / --expect).
+"""
+
+import json
+
+import pytest
+
+from pyrecover_tpu.telemetry import doctor, flight
+
+
+def write_events(path, events):
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "w") as f:
+        for i, e in enumerate(events):
+            rec = {"ts": 1000.0 + i, "host": 0, **e}
+            f.write(json.dumps(rec) + "\n")
+    return path
+
+
+def exp_with(tmp_path, events, name="exp"):
+    root = tmp_path / name
+    write_events(root / f"{name}_telemetry.jsonl", events)
+    return root
+
+
+RUN_START = {"event": "run_start", "devices": 8}
+
+
+def summary(status="finished", step=10, **extra):
+    return {"event": "run_summary", "status": status, "step": step, **extra}
+
+
+# ---- the classification table ----------------------------------------------
+
+def test_healthy(tmp_path):
+    root = exp_with(tmp_path, [RUN_START, summary()])
+    rep = doctor.diagnose(root)
+    assert rep["classification"] == "healthy"
+    assert doctor.exit_code(rep) == 0
+    assert rep["last_step"] == 10
+
+
+def test_crash_status_error(tmp_path):
+    root = exp_with(tmp_path, [RUN_START, summary(status="error", step=4)])
+    rep = doctor.diagnose(root)
+    assert rep["classification"] == "crash"
+    assert doctor.exit_code(rep) == 1
+
+
+def test_crash_hard_kill_names_phase_from_unpaired_spans(tmp_path):
+    # SIGKILL mid-save: the stream just stops; the open span_begin pair
+    # (ckpt_save > ckpt_write) names the in-flight phase
+    root = exp_with(tmp_path, [
+        RUN_START,
+        {"event": "step_time", "step": 6},
+        {"event": "span_begin", "span": 41, "name": "ckpt_save", "step": 6},
+        {"event": "span_begin", "span": 42, "name": "ckpt_write",
+         "parent": 41},
+    ])
+    rep = doctor.diagnose(root)
+    assert rep["classification"] == "crash"
+    assert "without a run_summary" in rep["detail"]
+    assert rep["phase"] == "ckpt_write"
+    assert rep["phase_stack"] == ["ckpt_save", "ckpt_write"]
+
+
+def test_closed_spans_do_not_name_a_phase(tmp_path):
+    root = exp_with(tmp_path, [
+        RUN_START,
+        {"event": "span_begin", "span": 1, "name": "eval"},
+        {"event": "span_end", "span": 1, "name": "eval"},
+    ])
+    rep = doctor.diagnose(root)
+    assert rep["phase"] is None
+
+
+def test_hang_even_when_run_later_finished(tmp_path):
+    root = exp_with(tmp_path, [
+        RUN_START,
+        {"event": "hang_detected", "silent_s": 7.5, "window_s": 5.0},
+        summary(),
+    ])
+    rep = doctor.diagnose(root)
+    assert rep["classification"] == "hang"
+    assert doctor.exit_code(rep) == 1
+
+
+def test_preemption_stopped_early(tmp_path):
+    root = exp_with(tmp_path, [
+        RUN_START,
+        {"event": "preempt_stop", "step": 8, "reason": "notice received"},
+        summary(status="stopped_early", step=8),
+    ])
+    rep = doctor.diagnose(root)
+    assert rep["classification"] == "preemption"
+    assert "notice received" in rep["detail"]
+
+
+def test_preemption_escalation_beats_hard_kill_rule(tmp_path):
+    # os._exit(75) after the second signal: no run_summary follows, but the
+    # escalation event makes this a preemption, not a crash
+    root = exp_with(tmp_path, [
+        RUN_START,
+        {"event": "preempt_signal_escalation", "signal": 15, "step": 9},
+    ])
+    rep = doctor.diagnose(root)
+    assert rep["classification"] == "preemption"
+    assert "escalated" in rep["detail"]
+
+
+def test_oom_from_exception_text(tmp_path):
+    root = exp_with(tmp_path, [RUN_START, summary(status="error", step=3)])
+    pm = root / ".postmortem" / "20260101T000000_01_unhandled_exception"
+    pm.mkdir(parents=True)
+    (pm / "MANIFEST.json").write_text(json.dumps({
+        "reason": "unhandled_exception",
+        "exception": {"type": "XlaRuntimeError",
+                      "message": "RESOURCE_EXHAUSTED: out of memory "
+                                 "allocating 17179869184 bytes"},
+    }))
+    (pm / "open_spans.json").write_text(json.dumps(
+        [{"name": "dispatch", "span": 7}]
+    ))
+    rep = doctor.diagnose(root)
+    assert rep["classification"] == "oom"
+    assert rep["phase"] == "dispatch"
+    assert "RESOURCE_EXHAUSTED" in rep["detail"]
+
+
+def test_oom_from_hbm_budget(tmp_path):
+    root = exp_with(tmp_path, [
+        RUN_START,
+        summary(status="error", step=3, hbm_peak_bytes=17e9,
+                hbm_budget_bytes=16e9, hbm_peak_pct=106.25),
+    ])
+    rep = doctor.diagnose(root)
+    assert rep["classification"] == "oom"
+    assert "106.25" in rep["detail"]
+
+
+def test_platform_fallback(tmp_path):
+    root = exp_with(tmp_path, [
+        RUN_START,
+        {"event": "platform_fallback", "reason": "probe hung for 120s",
+         "resolved": "cpu"},
+        summary(),
+    ])
+    rep = doctor.diagnose(root)
+    assert rep["classification"] == "platform_fallback"
+    assert "probe hung" in rep["detail"]
+
+
+def test_recompile_storm_threshold(tmp_path):
+    recompiles = [
+        {"event": "recompile", "fn": "train_step", "count": i + 1,
+         "changed": "leaf 3: ((4, 128), 'float32') -> ((4, 256), 'float32')"}
+        for i in range(3)
+    ]
+    root = exp_with(tmp_path, [RUN_START, *recompiles, summary()])
+    rep = doctor.diagnose(root)
+    assert rep["classification"] == "recompile_storm"
+    # below the threshold it is a finding on a healthy run, not the verdict
+    root2 = exp_with(tmp_path, [RUN_START, *recompiles[:2], summary()],
+                     name="exp2")
+    rep2 = doctor.diagnose(root2)
+    assert rep2["classification"] == "healthy"
+    assert any(f["kind"] == "recompile" for f in rep2["findings"])
+    # the threshold is tunable
+    rep3 = doctor.diagnose(root2, recompile_storm_threshold=2)
+    assert rep3["classification"] == "recompile_storm"
+
+
+def test_last_segment_wins(tmp_path):
+    # attempt 1 was SIGKILLed mid-save; attempt 2 resumed and finished:
+    # the chain is healthy, the kill is a footnote
+    root = exp_with(tmp_path, [
+        RUN_START,
+        {"event": "span_begin", "span": 5, "name": "ckpt_save"},
+        RUN_START,
+        {"event": "resume", "step": 6},
+        summary(step=10),
+    ])
+    rep = doctor.diagnose(root)
+    assert rep["classification"] == "healthy"
+    assert any(f["kind"] == "earlier_segments" for f in rep["findings"])
+
+
+def test_unknown_empty_dir(tmp_path):
+    (tmp_path / "empty").mkdir()
+    rep = doctor.diagnose(tmp_path / "empty")
+    assert rep["classification"] == "unknown"
+    assert doctor.exit_code(rep) == 2
+
+
+def test_diagnose_bare_jsonl_and_bundle_roots(tmp_path):
+    root = exp_with(tmp_path, [RUN_START, summary()])
+    jsonl = root / "exp_telemetry.jsonl"
+    assert doctor.diagnose(jsonl)["classification"] == "healthy"
+
+    # a real flight bundle, diagnosed by pointing AT the bundle dir
+    flight.install(root, enable_faulthandler=False)
+    try:
+        from pyrecover_tpu import telemetry
+
+        sink = telemetry.add_sink(telemetry.MemorySink())
+        span = telemetry.spans.begin("resume", step=0)
+        bundle = flight.dump("hang_detected", silent_s=9.0)
+        span.end()
+        telemetry.remove_sink(sink)
+    finally:
+        flight.uninstall()
+    rep = doctor.diagnose(bundle)
+    assert rep["classification"] == "hang"
+    assert rep["phase"] == "resume"
+
+
+# ---- CLI contract -----------------------------------------------------------
+
+def test_cli_json_and_exit_codes(tmp_path, capsys):
+    root = exp_with(tmp_path, [RUN_START, summary()])
+    out = tmp_path / "report.json"
+    rc = doctor.main([str(root), "--json", str(out)])
+    assert rc == 0
+    rep = json.loads(out.read_text())
+    assert rep["classification"] == "healthy"
+    assert "HEALTHY" in capsys.readouterr().out
+
+    root2 = exp_with(tmp_path, [RUN_START, summary(status="error")],
+                     name="exp2")
+    assert doctor.main([str(root2)]) == 1
+
+
+def test_cli_expect_gate(tmp_path, capsys):
+    root = exp_with(tmp_path, [
+        RUN_START, {"event": "hang_detected", "silent_s": 9}, summary(),
+    ])
+    assert doctor.main([str(root), "--expect", "hang"]) == 0
+    assert doctor.main([str(root), "--expect", "healthy"]) == 3
+    capsys.readouterr()

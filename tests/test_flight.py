@@ -1,0 +1,258 @@
+"""Flight recorder tests (pyrecover_tpu/telemetry/flight.py).
+
+Ring bounds + thread safety, open-span tracking, bundle structure and
+atomicity, dump-on-unhandled-exception and dump-on-fatal-signal proven in
+SUBPROCESSES (the hooks must work in a real dying interpreter, not just
+when called politely), and bundle discovery ordering.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from pyrecover_tpu import telemetry
+from pyrecover_tpu.telemetry import flight
+
+
+@pytest.fixture(autouse=True)
+def _clean_flight():
+    flight.uninstall()
+    yield
+    flight.uninstall()
+
+
+@pytest.fixture()
+def mem_sink():
+    sink = telemetry.add_sink(telemetry.MemorySink())
+    yield sink
+    telemetry.remove_sink(sink)
+
+
+# ---- ring sink --------------------------------------------------------------
+
+def test_ring_bounded():
+    ring = flight.RingSink(maxlen=16)
+    for i in range(1000):
+        ring.write({"event": "e", "i": i})
+    events, spans, last_step, _ = ring.snapshot()
+    assert len(events) == 16
+    assert events[-1]["i"] == 999
+    assert events[0]["i"] == 984
+
+
+def test_ring_tracks_last_step_and_ckpt():
+    ring = flight.RingSink(maxlen=4)
+    ring.write({"event": "step_time", "step": 7})
+    ring.write({"event": "step_time", "step": 3})  # replay never regresses
+    ring.write({"event": "ckpt_saved", "step": 6, "path": "ckpt_6.ckpt"})
+    _, _, last_step, last_ckpt = ring.snapshot()
+    assert last_step == 7
+    assert last_ckpt["path"] == "ckpt_6.ckpt"
+
+
+def test_ring_tracks_open_spans():
+    ring = flight.RingSink()
+    ring.write({"event": "span_begin", "span": 1, "name": "outer"})
+    ring.write({"event": "span_begin", "span": 2, "name": "inner"})
+    _, spans, _, _ = ring.snapshot()
+    assert [s["name"] for s in spans] == ["outer", "inner"]
+    ring.write({"event": "span_end", "span": 2, "name": "inner"})
+    _, spans, _, _ = ring.snapshot()
+    assert [s["name"] for s in spans] == ["outer"]
+
+
+def test_ring_thread_safety():
+    ring = flight.RingSink(maxlen=64)
+    stop = threading.Event()
+    errors = []
+
+    def writer(tid):
+        i = 0
+        while not stop.is_set():
+            try:
+                ring.write({"event": "span_begin", "span": (tid, i),
+                            "name": "s", "step": i})
+                ring.write({"event": "span_end", "span": (tid, i)})
+            except Exception as e:  # pragma: no cover - the failure mode
+                errors.append(e)
+                return
+            i += 1
+
+    def reader():
+        while not stop.is_set():
+            try:
+                ring.snapshot()
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=writer, args=(t,)) for t in range(4)]
+    threads.append(threading.Thread(target=reader))
+    for t in threads:
+        t.start()
+    import time
+
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not errors
+    events, spans, _, _ = ring.snapshot()
+    assert len(events) == 64
+    assert not spans  # every begin was closed
+
+
+# ---- live dump --------------------------------------------------------------
+
+def test_dump_bundle_structure(tmp_path, mem_sink):
+    exp = tmp_path / "exp"
+    flight.install(exp, config={"training_steps": 5, "seed": 0})
+    telemetry.emit("run_start", devices=1)
+    telemetry.emit("step_time", step=3)
+    span = telemetry.spans.begin("ckpt_save", step=3)
+    bundle = flight.dump("unit_test", custom_field="x")
+    span.end()
+    assert bundle is not None and bundle.is_dir()
+    assert bundle.parent == exp / flight.POSTMORTEM_DIRNAME
+
+    manifest = json.loads((bundle / "MANIFEST.json").read_text())
+    assert manifest["reason"] == "unit_test"
+    assert manifest["last_step"] == 3
+    assert manifest["custom_field"] == "x"
+    assert manifest["platform"]["pid"] == os.getpid()
+
+    lines = (bundle / "events.jsonl").read_text().splitlines()
+    events = [json.loads(ln) for ln in lines]
+    assert any(e["event"] == "run_start" for e in events)
+
+    spans = json.loads((bundle / "open_spans.json").read_text())
+    assert [s["name"] for s in spans] == ["ckpt_save"]
+
+    cfg = json.loads((bundle / "config.json").read_text())
+    assert cfg["training_steps"] == 5
+
+    stacks = (bundle / "stacks.txt").read_text()
+    assert "test_dump_bundle_structure" in stacks  # this frame is live
+
+    env = json.loads((bundle / "env.json").read_text())
+    assert all(k.startswith(flight._ENV_PREFIXES) for k in env)
+
+    # the dump itself is announced on the bus (durable JSONL cross-ref)
+    dumps = [e for e in mem_sink.events if e["event"] == "flight_dump"]
+    assert len(dumps) == 1 and dumps[0]["reason"] == "unit_test"
+
+
+def test_dump_atomic_no_tmp_left(tmp_path):
+    flight.install(tmp_path / "exp")
+    flight.dump("a")
+    flight.dump("b")
+    pm = tmp_path / "exp" / flight.POSTMORTEM_DIRNAME
+    assert not [p for p in pm.iterdir() if p.name.startswith(".tmp_")]
+    assert len(flight.list_bundles(tmp_path / "exp")) == 2
+
+
+def test_dump_rate_limited(tmp_path):
+    rec = flight.install(tmp_path / "exp")
+    paths = [rec.dump(f"r{i}") for i in range(flight.MAX_DUMPS_PER_PROCESS + 5)]
+    assert sum(p is not None for p in paths) == flight.MAX_DUMPS_PER_PROCESS
+
+
+def test_dump_without_install_is_noop():
+    assert flight.dump("nothing") is None
+
+
+def test_uninstall_restores_hooks_and_prunes_empty_fatal(tmp_path):
+    prev_hook = sys.excepthook
+    flight.install(tmp_path / "exp")
+    assert sys.excepthook is not prev_hook
+    fatal = tmp_path / "exp" / flight.POSTMORTEM_DIRNAME / flight.FATAL_STACKS_NAME
+    assert fatal.exists()
+    flight.uninstall()
+    assert sys.excepthook is prev_hook
+    # nothing fatal happened: the empty file (and the then-empty dir) go
+    assert not fatal.exists()
+    assert not (tmp_path / "exp" / flight.POSTMORTEM_DIRNAME).exists()
+
+
+def test_list_bundles_accepts_every_root_shape(tmp_path):
+    flight.install(tmp_path / "exp")
+    b = flight.dump("x")
+    assert flight.list_bundles(tmp_path / "exp") == [b]
+    assert flight.list_bundles(tmp_path / "exp" / ".postmortem") == [b]
+    assert flight.list_bundles(b) == [b]
+    assert flight.list_bundles(tmp_path / "elsewhere") == []
+
+
+# ---- crash hooks, proven in subprocesses ------------------------------------
+
+_SUBPROC_PRELUDE = """
+import os, sys
+sys.path.insert(0, {repo!r})
+from pyrecover_tpu.telemetry import flight
+flight.install({exp!r}, config={{"who": "subproc"}})
+"""
+
+
+def _run_sub(tmp_path, body, expect_rc=None):
+    exp = str(tmp_path / "exp")
+    code = _SUBPROC_PRELUDE.format(
+        repo=str(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        exp=exp,
+    ) + body
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, timeout=120,
+    )
+    if expect_rc is not None:
+        assert proc.returncode == expect_rc, proc.stderr.decode()
+    return proc
+
+
+def test_dump_on_unhandled_exception_in_subprocess(tmp_path):
+    proc = _run_sub(
+        tmp_path,
+        "raise ValueError('boom at step 12')\n",
+        expect_rc=1,
+    )
+    assert b"boom at step 12" in proc.stderr  # traceback still printed
+    bundles = flight.list_bundles(tmp_path / "exp")
+    assert len(bundles) == 1
+    manifest = json.loads((bundles[0] / "MANIFEST.json").read_text())
+    assert manifest["reason"] == "unhandled_exception"
+    assert manifest["exception"]["type"] == "ValueError"
+    assert "boom at step 12" in manifest["exception"]["message"]
+
+
+def test_dump_on_thread_exception_in_subprocess(tmp_path):
+    _run_sub(
+        tmp_path,
+        "import threading\n"
+        "t = threading.Thread(target=lambda: 1 / 0, name='worker')\n"
+        "t.start(); t.join()\n",
+        expect_rc=0,  # a thread death does not kill the process
+    )
+    bundles = flight.list_bundles(tmp_path / "exp")
+    assert len(bundles) == 1
+    manifest = json.loads((bundles[0] / "MANIFEST.json").read_text())
+    assert manifest["reason"] == "thread_exception"
+    assert manifest["thread"] == "worker"
+
+
+def test_fatal_signal_writes_stacks_in_subprocess(tmp_path):
+    proc = _run_sub(
+        tmp_path,
+        "import signal\n"
+        "os.kill(os.getpid(), signal.SIGSEGV)\n",
+    )
+    assert proc.returncode == -signal.SIGSEGV
+    fatal = (
+        tmp_path / "exp" / flight.POSTMORTEM_DIRNAME
+        / flight.FATAL_STACKS_NAME
+    )
+    assert fatal.exists() and fatal.stat().st_size > 0
+    text = fatal.read_text()
+    assert "Segmentation fault" in text or "SIGSEGV" in text

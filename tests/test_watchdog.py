@@ -1,0 +1,123 @@
+"""Run-health watchdog tests (pyrecover_tpu/telemetry/watchdog.py).
+
+Heartbeat/no-heartbeat behavior on short windows: silence fires exactly
+one ``hang_detected`` per stall, steady heartbeats never fire, progress
+re-arms, and a fired hang writes a flight-recorder bundle without
+touching the run.
+"""
+
+import time
+
+import pytest
+
+from pyrecover_tpu import telemetry
+from pyrecover_tpu.telemetry import flight, watchdog
+
+
+@pytest.fixture()
+def mem_sink():
+    sink = telemetry.add_sink(telemetry.MemorySink())
+    yield sink
+    telemetry.remove_sink(sink)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    if watchdog._active is not None:
+        watchdog._active.stop()
+    flight.uninstall()
+
+
+def hangs(sink):
+    return [e for e in sink.events if e["event"] == "hang_detected"]
+
+
+def wait_until(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def test_silence_fires_once(mem_sink):
+    wd = watchdog.Watchdog(0.2, interval_s=0.05, dump_bundle=False).start()
+    try:
+        wd.beat("train_loop")
+        assert wait_until(lambda: hangs(mem_sink), timeout=10)
+        # a stall fires ONCE, not once per poll
+        time.sleep(0.4)
+        assert len(hangs(mem_sink)) == 1
+        ev = hangs(mem_sink)[0]
+        assert ev["silent_s"] >= 0.2
+        assert ev["window_s"] == 0.2
+        assert "train_loop" in ev["sources"]
+    finally:
+        wd.stop()
+
+
+def test_heartbeats_prevent_firing(mem_sink):
+    wd = watchdog.Watchdog(0.3, interval_s=0.05, dump_bundle=False).start()
+    try:
+        deadline = time.monotonic() + 1.0
+        while time.monotonic() < deadline:
+            wd.beat("train_loop")
+            time.sleep(0.03)
+        assert not hangs(mem_sink)
+    finally:
+        wd.stop()
+
+
+def test_progress_rearms_for_second_stall(mem_sink):
+    wd = watchdog.Watchdog(0.15, interval_s=0.03, dump_bundle=False).start()
+    try:
+        wd.beat("loader")
+        assert wait_until(lambda: len(hangs(mem_sink)) == 1, timeout=10)
+        wd.beat("loader")  # progress resumed: re-arm
+        assert wait_until(lambda: len(hangs(mem_sink)) == 2, timeout=10)
+        assert wd.hang_count == 2
+    finally:
+        wd.stop()
+
+
+def test_module_level_beat_noop_without_active():
+    watchdog.beat("train_loop")  # must not raise, nothing installed
+
+
+def test_module_level_beat_reaches_active(mem_sink):
+    wd = watchdog.Watchdog(0.5, interval_s=0.05, dump_bundle=False).start()
+    try:
+        watchdog.beat("loader")
+        assert "loader" in wd._beats
+    finally:
+        wd.stop()
+    assert watchdog._active is None  # stop() deregisters
+
+
+def test_hang_dumps_flight_bundle(tmp_path, mem_sink):
+    flight.install(tmp_path / "exp")
+    wd = watchdog.Watchdog(0.15, interval_s=0.03).start()
+    try:
+        wd.beat("train_loop")
+        assert wait_until(
+            lambda: flight.list_bundles(tmp_path / "exp"), timeout=10
+        )
+    finally:
+        wd.stop()
+    import json
+
+    bundle = flight.list_bundles(tmp_path / "exp")[0]
+    manifest = json.loads((bundle / "MANIFEST.json").read_text())
+    assert manifest["reason"] == "hang_detected"
+    assert "train_loop" in manifest["sources"]
+    # the bundle announcement went through the bus too
+    assert any(e["event"] == "flight_dump" for e in mem_sink.events)
+
+
+def test_stop_is_idempotent_and_joins():
+    wd = watchdog.Watchdog(5.0, interval_s=0.05).start()
+    wd.stop()
+    wd.stop()
+    assert wd._thread is None

@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""doctor CLI — crash forensics: classify why a run died from artifacts.
+
+Usage:
+    python tools/doctor.py <exp_dir | bundle | telemetry.jsonl>
+    python tools/doctor.py /tmp/chaos/hang --expect hang --json report.json
+
+All logic lives in ``pyrecover_tpu.telemetry.doctor`` (bundles are written
+by ``pyrecover_tpu.telemetry.flight``); this file is the executable shim so
+the tool is runnable before the package is installed.
+"""
+
+import sys
+from pathlib import Path
+
+# runnable from any cwd, installed or not
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from pyrecover_tpu.telemetry.doctor import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

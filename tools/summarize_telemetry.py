@@ -134,10 +134,40 @@ def aggregate(events):
     # latest metrics_snapshot per histogram: the flushed registry carries
     # loader-wait / ckpt-phase / retry-latency percentiles per host
     hists = {}
+    gauges = {}
     for e in by.get("metrics_snapshot", []):
         for name, h in (e.get("hists") or {}).items():
             hists[name] = h
+        gauges.update(e.get("gauges") or {})
     agg["metric_hists"] = hists
+    agg["gauges"] = gauges
+
+    # run-health rollup: the silent-failure detectors' event trail plus
+    # peak-HBM-vs-budget from the run_summary records (max over segments)
+    health = {
+        "recompiles": len(by.get("recompile", [])),
+        "implicit_transfers": len(by.get("implicit_transfer", [])),
+        "platform_fallbacks": len(by.get("platform_fallback", [])),
+        "hangs": len(by.get("hang_detected", [])),
+        "flight_dumps": len(by.get("flight_dump", [])),
+        "hbm_peak_bytes": None,
+        "hbm_budget_bytes": None,
+        "hbm_peak_pct": None,
+    }
+    for e in by.get("run_summary", []):
+        peak = e.get("hbm_peak_bytes")
+        if isinstance(peak, (int, float)) and (
+            health["hbm_peak_bytes"] is None
+            or peak > health["hbm_peak_bytes"]
+        ):
+            health["hbm_peak_bytes"] = int(peak)
+            health["hbm_budget_bytes"] = e.get("hbm_budget_bytes")
+            health["hbm_peak_pct"] = e.get("hbm_peak_pct")
+    if health["hbm_peak_bytes"] is None:
+        peak_gauge = gauges.get("hbm_peak_bytes_in_use")
+        if isinstance(peak_gauge, (int, float)):
+            health["hbm_peak_bytes"] = int(peak_gauge)
+    agg["health"] = health
 
     ckpt = {}
     for e in by.get("ckpt_save_blocking", []):
@@ -251,6 +281,35 @@ def render(agg, out=None):
                 continue
             w(f"  {name:<24} x{h.get('count', 0):<6} p50 {p50 * 1e3:9.2f}ms  "
               f"p95 {p95 * 1e3:9.2f}ms  p99 {p99 * 1e3:9.2f}ms\n")
+    h = agg.get("health", {})
+    if h.get("hbm_peak_bytes") is not None or any(
+        h.get(k) for k in ("recompiles", "implicit_transfers",
+                           "platform_fallbacks", "hangs", "flight_dumps")
+    ):
+        w("\n-- run health (silent-failure detectors) -----------------------\n")
+        if h.get("hbm_peak_bytes") is not None:
+            line = f"  peak HBM           {h['hbm_peak_bytes'] / 1e9:.2f} GB"
+            if h.get("hbm_peak_pct") is not None:
+                line += (
+                    f"  ({h['hbm_peak_pct']:.1f}% of "
+                    f"{h['hbm_budget_bytes'] / 1e9:.1f} GB budget)"
+                )
+            w(line + "\n")
+        w(f"  recompiles         {h.get('recompiles', 0)}"
+          + ("  <- shape/dtype drift retracing the train step"
+             if h.get("recompiles") else "") + "\n")
+        if h.get("implicit_transfers"):
+            w(f"  implicit transfers {h['implicit_transfers']}"
+              f"  <- host<->device syncs inside the guarded dispatch\n")
+        if h.get("platform_fallbacks"):
+            w(f"  PLATFORM FALLBACKS {h['platform_fallbacks']}"
+              f"  <- ran on CPU; perf numbers are not accelerator numbers\n")
+        if h.get("hangs"):
+            w(f"  HANGS DETECTED     {h['hangs']}"
+              f"  (postmortem bundles: {h.get('flight_dumps', 0)} — "
+              f"run `doctor` on the experiment dir)\n")
+        elif h.get("flight_dumps"):
+            w(f"  flight dumps       {h['flight_dumps']}\n")
     if agg["ckpt"]:
         w("\n-- checkpoint lifecycle ----------------------------------------\n")
         for eng, c in sorted(agg["ckpt"].items()):
@@ -308,6 +367,8 @@ def main(argv=None):
                 "totals": agg["totals"],
                 "steps": agg["steps"],
                 "metric_hists": agg["metric_hists"],
+                "gauges": agg["gauges"],
+                "health": agg["health"],
                 "ckpt": agg["ckpt"],
                 "data_stalls": agg["data_stalls"],
                 "preempt": agg["preempt"],
