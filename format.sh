@@ -64,7 +64,10 @@ fi
 # on ANY continuity or quarantine violation: the stitched loss CSV must be
 # bit-exact against an uninterrupted golden run, exactly the injected
 # corruption quarantined, and the ckpt_io_retry/ckpt_quarantined telemetry
-# trail present. JSON report at CHAOS_JSON, beside the other gate reports.
+# trail present. Also gates the elastic_shrink drill (kill at 4 virtual
+# devices -> resume on 2 -> grow back to 4, loss continuity + the
+# elastic_resume telemetry trail) and the hang-watchdog drill. JSON report
+# at CHAOS_JSON, beside the other gate reports.
 # The workdir is kept (and pre-cleaned) so the traceview smoke below can
 # merge the telemetry shards the soak just produced.
 CHAOS_WORK="${CHAOS_WORK:-/tmp/pyrecover_chaos_smoke}"

@@ -20,6 +20,10 @@ would otherwise only surface minutes into a multi-host TPU job:
   psum from the pipeline and ring-attention shard_maps) and sharding
   constraints, plus an analytic model of the GSPMD-inserted per-step
   collectives (gradient allreduce, ZeRO param allgathers).
+* **elastic-resume preflight** (``checkpoint/elastic.py`` consumes
+  this catalog) — SC11 ``reshard-infeasible`` rejects restore-time
+  reshard plans the partition rules cannot express on a target mesh,
+  and SC05 doubles as the target-HBM gate, BEFORE any restore I/O.
 * **checkpoint schema diff** (``manifest.py``) — one manifest schema
   (pytree paths, shapes, dtypes, pspecs) emitted at save time by BOTH
   checkpoint engines and statically diffed against the current model at
@@ -27,7 +31,7 @@ would otherwise only surface minutes into a multi-host TPU job:
   instead of mid-restore.
 
 Findings reuse the jaxlint ``Finding`` dataclass and severity
-conventions; check ids are ``SC01..SC10`` (``checks.CHECKS`` is the
+conventions; check ids are ``SC01..SC11`` (``checks.CHECKS`` is the
 catalog). Entry points: ``tools/shardcheck.py`` (CLI; ``--strict`` is
 the CI gate wired into ``format.sh``) and :func:`runner.check_preset` /
 :func:`runner.preflight` for programmatic use.

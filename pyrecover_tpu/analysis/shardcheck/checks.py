@@ -39,6 +39,10 @@ CHECKS = {
     "SC10": ("manifest-pspec-drift", "warning",
              "a leaf changed partition spec between checkpoint and model "
              "(restore reshards, but the layout intent drifted)"),
+    "SC11": ("reshard-infeasible", "error",
+             "an elastic-resume reshard plan cannot be expressed on the "
+             "target mesh (indivisible leaf dim, unresolvable mesh, or a "
+             "data pipeline that cannot rescale to the new replica count)"),
 }
 
 

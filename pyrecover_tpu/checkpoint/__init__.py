@@ -11,6 +11,13 @@ from pyrecover_tpu.checkpoint.sharded import (
     precheck_ckpt_sharded,
     save_ckpt_sharded,
 )
+from pyrecover_tpu.checkpoint.elastic import (
+    TopologyMismatchError,
+    compute_reshard_plan,
+    preflight_elastic,
+    read_saved_meta,
+    topologies_differ,
+)
 
 __all__ = [
     "checkpoint_path",
@@ -23,4 +30,9 @@ __all__ = [
     "save_ckpt_sharded",
     "load_ckpt_sharded",
     "precheck_ckpt_sharded",
+    "TopologyMismatchError",
+    "compute_reshard_plan",
+    "preflight_elastic",
+    "read_saved_meta",
+    "topologies_differ",
 ]

@@ -56,9 +56,14 @@ class ShardedCheckpointer:
         # two producers): preflight/resume diff it without tensor reads
         from pyrecover_tpu.analysis.shardcheck.manifest import state_manifest
 
+        from pyrecover_tpu.parallel.mesh import state_topology
+
         meta = {
             "sampler": sampler_state or {},
             "manifest": state_manifest(state),
+            # saved topology: the elastic-resume gate (checkpoint/elastic.py)
+            # diffs this against the live mesh before any tensor read
+            "topology": state_topology(state),
         }
         if extra_meta:
             meta.update(extra_meta)

@@ -42,7 +42,7 @@ from flax.serialization import msgpack_restore
 
 from pyrecover_tpu import telemetry
 from pyrecover_tpu.checkpoint.registry import prune_checkpoints
-from pyrecover_tpu.parallel.mesh import sync_global_devices
+from pyrecover_tpu.parallel.mesh import state_topology, sync_global_devices
 from pyrecover_tpu.resilience import faults
 from pyrecover_tpu.resilience.retry import io_retry
 from pyrecover_tpu.utils.logging import log_host0
@@ -242,6 +242,9 @@ def save_ckpt_vanilla(path, state, sampler_state=None, *, verify=False,
             for _, x in path_leaves
         ],
         "manifest": manifest,
+        # the topology this state spans — the elastic-resume gate diffs it
+        # against the live mesh from the header alone (checkpoint/elastic.py)
+        "topology": state_topology(state),
     }
     if extra_meta:
         meta.update(extra_meta)

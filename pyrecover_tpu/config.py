@@ -83,6 +83,11 @@ class TrainConfig:
     max_kept_checkpoints: int = 3
     sharded_checkpoint: bool = False  # --use-torch-distributed-ckpt equivalent
     async_checkpoint: bool = True  # overlap sharded saves with training
+    # topology-elastic resume (checkpoint/elastic.py): "auto" reshards a
+    # checkpoint saved on a different topology onto the live mesh (after a
+    # mandatory shardcheck preflight), "on" always runs the elastic gate,
+    # "off" fails loud with TopologyMismatchError on any topology drift
+    elastic_resume: str = "auto"  # auto | on | off
     # -- time-aware checkpointing / preemption -------------------------------
     timeaware_checkpointing: bool = False
     default_iter_time: float = 1.0
@@ -283,6 +288,14 @@ def build_parser():
                    dest="sharded_checkpoint", action="store_true",
                    help="Sharded multi-host checkpoint (Orbax/tensorstore).")
     p.add_argument("--no-async-checkpoint", action="store_true")
+    p.add_argument("--elastic-resume", type=str, default=d.elastic_resume,
+                   choices=["auto", "on", "off"],
+                   help="Restore a checkpoint saved on a DIFFERENT topology "
+                        "onto the live mesh (reshard at restore time, after "
+                        "a shardcheck preflight proves the plan feasible and "
+                        "fits HBM). auto: reshard when the topology differs; "
+                        "on: always run the elastic gate; off: raise a typed "
+                        "TopologyMismatchError on any topology drift.")
 
     # time-aware (utils.py:233-248)
     p.add_argument("--timeaware-checkpointing", action="store_true")
@@ -393,6 +406,7 @@ def get_args(argv=None):
         max_kept_checkpoints=ns.max_kept_checkpoints,
         sharded_checkpoint=ns.sharded_checkpoint,
         async_checkpoint=not ns.no_async_checkpoint,
+        elastic_resume=ns.elastic_resume,
         timeaware_checkpointing=ns.timeaware_checkpointing,
         default_iter_time=ns.default_iter_time,
         default_ckpt_time=ns.default_ckpt_time,

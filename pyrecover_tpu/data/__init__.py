@@ -1,6 +1,11 @@
 from pyrecover_tpu.data.collate import collate_clm
 from pyrecover_tpu.data.loader import DataLoader, LoaderStallError
-from pyrecover_tpu.data.sampler import StatefulSampler
+from pyrecover_tpu.data.sampler import (
+    StatefulSampler,
+    merge_sampler_states,
+    rescale_sampler_state,
+    split_sampler_state,
+)
 from pyrecover_tpu.data.synthetic import SyntheticTextDataset
 
 __all__ = [
@@ -8,5 +13,8 @@ __all__ = [
     "DataLoader",
     "LoaderStallError",
     "StatefulSampler",
+    "split_sampler_state",
+    "merge_sampler_states",
+    "rescale_sampler_state",
     "SyntheticTextDataset",
 ]

@@ -155,6 +155,49 @@ def mesh_axis_size(mesh, axis):
     return mesh.shape.get(axis, 1)
 
 
+def mesh_shape_dict(mesh):
+    """Plain ``{axis: size}`` dict of a mesh's logical shape (JSON-ready)."""
+    return {str(k): int(v) for k, v in dict(mesh.shape).items()}
+
+
+def topology_of(mesh):
+    """JSON-ready record of the topology a mesh spans: device count,
+    process count, and the logical mesh shape. Saved into every
+    checkpoint's metadata so an elastic resume can diff the saved
+    topology against the live one without reading any tensor data."""
+    return {
+        "devices": int(np.asarray(mesh.devices).size),
+        "processes": int(jax.process_count()),
+        "mesh": mesh_shape_dict(mesh),
+    }
+
+
+def state_topology(state):
+    """Topology spanned by a live state pytree: the mesh carried by the
+    first NamedSharding leaf, else the device span of the first jax.Array
+    (host/numpy-only trees report the process's device view). This is how
+    the checkpoint engines record topology without being handed a mesh."""
+    from jax.sharding import NamedSharding
+
+    for leaf in jax.tree_util.tree_leaves(state):
+        sharding = getattr(leaf, "sharding", None)
+        if isinstance(sharding, NamedSharding):
+            return topology_of(sharding.mesh)
+    for leaf in jax.tree_util.tree_leaves(state):
+        device_set = getattr(getattr(leaf, "sharding", None), "device_set", None)
+        if device_set:
+            return {
+                "devices": len(device_set),
+                "processes": int(jax.process_count()),
+                "mesh": None,
+            }
+    return {
+        "devices": int(jax.device_count()),
+        "processes": int(jax.process_count()),
+        "mesh": None,
+    }
+
+
 _dropped_axes_warned = set()
 
 

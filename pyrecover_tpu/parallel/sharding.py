@@ -67,6 +67,33 @@ def _leaf_rule(path):
     return None
 
 
+_KEYSTR_TOKEN = None  # compiled lazily; regex import kept off the hot path
+
+
+def spec_for_manifest_path(path_str, ndim):
+    """Target PartitionSpec for a checkpoint-manifest leaf path.
+
+    The string twin of ``_leaf_rule`` + ``train.state_pspecs``: manifest
+    paths are ``jax.tree_util.keystr`` strings (``.params['layers']['wq']``,
+    ``.opt_state[0].mu['wq']``), so the same innermost-key-wins rule lookup
+    resolves them without a live pytree — which is what lets a reshard
+    plan be computed from a manifest alone, no devices, no model build.
+    Falls back to fully replicated when no rule matches or the rule's rank
+    disagrees with the leaf (exactly the ``state_pspecs`` behavior).
+    """
+    global _KEYSTR_TOKEN
+    if _KEYSTR_TOKEN is None:
+        import re
+
+        _KEYSTR_TOKEN = re.compile(r"\['([^']+)'\]|\.([A-Za-z_]\w*)|\[(\d+)\]")
+    keys = [a or b or c for a, b, c in _KEYSTR_TOKEN.findall(path_str or "")]
+    for key in reversed(keys):
+        rule = _RULES.get(key)
+        if rule is not None:
+            return rule if len(rule) == ndim else P(*([None] * ndim))
+    return P(*([None] * ndim))
+
+
 def param_pspecs(params):
     """PartitionSpec pytree matching ``params``' structure."""
 

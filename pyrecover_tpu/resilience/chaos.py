@@ -24,6 +24,12 @@ A smoke soak is four trainer runs over one experiment directory::
                the run survives, but hang_detected + a postmortem bundle
                must appear and `doctor` must classify a hang wedged in
                the loader_wait phase
+    cycles 6-9: elastic_shrink drill in its own exp dirs — a 4-device
+               golden run, then kill at 4 devices → resume on a 2-device
+               mesh (the topology-elastic reshard path) → grow back to 4
+               and finish; gated on loss continuity vs the golden
+               (bit-exact before the shrink, tolerance-aware after) and
+               the elastic_resume/sampler_rescaled telemetry trail
 
 Verdicts: per-cycle exit codes, stitched CSV == golden CSV, exactly the
 injected corruption quarantined (zero non-injected losses), and the
@@ -55,8 +61,9 @@ _TINY_MODEL_ARGS = (
 )
 
 PRESETS = {
-    # CI-speed: 2 fault kinds per kill cycle, tiny model, CPU, ~6 runs
-    # (golden + 4 kill/corrupt/resume cycles + the hang drill)
+    # CI-speed: 2 fault kinds per kill cycle, tiny model, CPU, ~10 runs
+    # (golden + 4 kill/corrupt/resume cycles + the hang drill + the
+    # 4-run elastic_shrink drill)
     "smoke": dict(
         training_steps=10, checkpoint_frequency=3, batch_size=8,
         sequence_length=32, training_samples=64, run_timeout_s=240,
@@ -98,10 +105,22 @@ def _trainer_cmd(preset, exp, seed, workdir, *, resume=False,
     return cmd
 
 
-def _run_trainer(cmd, *, fault_plan, log_path, timeout_s):
+def _run_trainer(cmd, *, fault_plan, log_path, timeout_s, device_count=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("PALLAS_AXON_POOL_IPS", None)  # no accelerator plugin probing
+    if device_count is not None:
+        # the elastic drill pins each cycle's VIRTUAL device count (kill at
+        # 4, resume at 2, grow back to 4); any inherited forced count (e.g.
+        # pytest's 8) must not leak through
+        flags = [
+            f for f in env.get("XLA_FLAGS", "").split()
+            if not f.startswith("--xla_force_host_platform_device_count")
+        ]
+        flags.append(
+            f"--xla_force_host_platform_device_count={int(device_count)}"
+        )
+        env["XLA_FLAGS"] = " ".join(flags)
     # exercise telemetry JSONL rotation under real kill/resume cycles: a
     # tiny byte cap forces several rotations per run, and the keep depth is
     # raised so the merged read-back (and the event-trail gates below)
@@ -143,6 +162,71 @@ def _schedule(preset, seed):
     return s1, s2
 
 
+# relative per-step loss tolerance for the post-shrink segment of the
+# elastic drill: a changed replica count changes the cross-device
+# reduction order (and per-replica batch composition), so the float
+# trajectory drifts in the low-order bits — measured ~1e-5 on the smoke
+# preset; the gate leaves headroom without ever accepting a divergence
+ELASTIC_RTOL = 0.05
+
+
+def _elastic_continuity(golden_rows, rows, steps, shrink_step,
+                        rtol=ELASTIC_RTOL):
+    """Gate the elastic drill's stitched loss CSV against its same-seed
+    4-device golden: bit-exact through the last step before the topology
+    first changed, within ``rtol`` relative after it, exact step sequence
+    throughout. Returns ``(info, violations)``."""
+    violations = []
+    info = {"rows": len(rows), "bitexact_rows": 0, "max_rel_diff": 0.0,
+            "shrink_step": shrink_step, "rtol": rtol}
+    if len(rows) != steps + 1 or len(golden_rows) != steps + 1:
+        violations.append(
+            f"elastic drill: {len(rows)} stitched rows vs "
+            f"{len(golden_rows)} golden (want {steps + 1})"
+        )
+        return info, violations
+    if rows[0] != golden_rows[0]:
+        violations.append("elastic drill: CSV headers differ")
+        return info, violations
+    for i, (g, r) in enumerate(zip(golden_rows[1:], rows[1:]), start=1):
+        try:
+            gs, gl = g.split(",")
+            rs, rl = r.split(",")
+            gs, rs, gl, rl = int(gs), int(rs), float(gl), float(rl)
+        except ValueError:
+            violations.append(
+                f"elastic drill: unparseable CSV row {i}: {g!r} vs {r!r}"
+            )
+            return info, violations
+        if gs != i or rs != i:
+            violations.append(
+                f"elastic drill: step sequence broken at row {i}: "
+                f"golden step {gs}, stitched step {rs}"
+            )
+            return info, violations
+        if i <= shrink_step:
+            # same topology, same seed, deterministic CPU: any drift here
+            # means the resume machinery, not float noise
+            if g != r:
+                violations.append(
+                    f"elastic drill: pre-shrink row {i} not bit-exact: "
+                    f"{g!r} vs {r!r}"
+                )
+                return info, violations
+            info["bitexact_rows"] = i
+        else:
+            rel = abs(rl - gl) / max(abs(gl), 1e-12)
+            info["max_rel_diff"] = max(info["max_rel_diff"], rel)
+            if rel > rtol:
+                violations.append(
+                    f"elastic drill: loss diverged at step {i}: golden "
+                    f"{gl} vs stitched {rl} (rel {rel:.5f} > {rtol})"
+                )
+                return info, violations
+    info["max_rel_diff"] = round(info["max_rel_diff"], 8)
+    return info, violations
+
+
 def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
     """Run the kill/corrupt/resume soak. Returns the report dict
     (``report["ok"]`` is the gate verdict)."""
@@ -158,13 +242,13 @@ def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
     cycles = []
 
     def cycle(name, *, fault_plan, resume, expect_rc, exp="chaos",
-              extra_args=()):
+              extra_args=(), device_count=None):
         cmd = _trainer_cmd(preset, exp, seed, workdir, resume=resume,
                            extra_args=extra_args)
         try:
             rc, secs = _run_trainer(
                 cmd, fault_plan=fault_plan, log_path=log_path,
-                timeout_s=timeout,
+                timeout_s=timeout, device_count=device_count,
             )
         except subprocess.TimeoutExpired:
             rc, secs = "timeout", timeout
@@ -228,6 +312,29 @@ def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
                   {"type": "loader_stall", "seconds": 20.0, "batch": 9},
               ],
           })
+
+    # cycles 6-9 — elastic_shrink drill (own exp dirs; the main continuity
+    # gates are untouched): a golden run on a 4-device virtual mesh, then
+    # kill at 4 devices → resume on 2 (the elastic reshard path) → grow
+    # back to 4 and finish. The stitched loss CSV is gated against the
+    # 4-device golden: BIT-EXACT up to the first kill (same topology, same
+    # seed), tolerance-aware after it (a different replica count changes
+    # the cross-device reduction order and per-replica batch composition,
+    # which perturbs the float trajectory without breaking continuity).
+    cycle("elastic_golden", resume=False, expect_rc=(0,),
+          exp="elastic_golden", fault_plan=None, device_count=4)
+    cycle("elastic_kill@4dev", resume=False, expect_rc=(0,), exp="elastic",
+          device_count=4, fault_plan={
+              "seed": seed,
+              "faults": [{"type": "sigterm_at_step", "step": s1}],
+          })
+    cycle("elastic_shrink@2dev", resume=True, expect_rc=(0,), exp="elastic",
+          device_count=2, fault_plan={
+              "seed": seed,
+              "faults": [{"type": "sigterm_at_step", "step": s2}],
+          })
+    cycle("elastic_regrow@4dev", resume=True, expect_rc=(0,), exp="elastic",
+          device_count=4, fault_plan=None)
 
     exp_dir = workdir / "chaos"
     golden_rows = _read_csv_rows(
@@ -316,6 +423,47 @@ def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
             "hang drill: no flight_dump event in the telemetry stream"
         )
 
+    # elastic drill verdicts: stitched-vs-golden continuity (bit-exact
+    # before the shrink, tolerance-aware after), the 4→2 and 2→4
+    # elastic_resume transitions with their sampler rescales in the
+    # telemetry trail, a DONE marker, and a healthy doctor verdict
+    elastic_dir = workdir / "elastic"
+    elastic_info, e_viol = _elastic_continuity(
+        _read_csv_rows(
+            workdir / "elastic_golden" / "elastic_golden_loss_log.csv"
+        ),
+        _read_csv_rows(elastic_dir / "elastic_loss_log.csv"),
+        steps, s1,
+    )
+    violations += e_viol
+    if not (elastic_dir / "DONE").exists():
+        violations.append(
+            "elastic drill: no DONE marker after the regrow cycle"
+        )
+    e_events = read_events(elastic_dir / "elastic_telemetry.jsonl")
+    transitions = [
+        ((e.get("saved_topology") or {}).get("devices"),
+         (e.get("target_topology") or {}).get("devices"))
+        for e in e_events if e["event"] == "elastic_resume"
+    ]
+    elastic_info["transitions"] = transitions
+    if (4, 2) not in transitions or (2, 4) not in transitions:
+        violations.append(
+            "elastic drill: expected 4→2 and 2→4 elastic_resume "
+            f"transitions in telemetry, got {transitions}"
+        )
+    if not any(e["event"] == "sampler_rescaled" for e in e_events):
+        violations.append(
+            "elastic drill: no sampler_rescaled telemetry event"
+        )
+    e_doctor = doctor_mod.diagnose(elastic_dir)
+    elastic_info["doctor_classification"] = e_doctor["classification"]
+    if e_doctor["classification"] != "healthy":
+        violations.append(
+            "elastic drill: doctor classified "
+            f"{e_doctor['classification']!r}, expected 'healthy'"
+        )
+
     report = {
         "preset": preset_name,
         "seed": seed,
@@ -338,6 +486,7 @@ def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
             "doctor_classification": hang_doctor["classification"],
             "doctor_phase": hang_doctor.get("phase"),
         },
+        "elastic": elastic_info,
         "telemetry_rotated_shards": rotated,
         "telemetry_counts": {
             k: counts.get(k, 0)
@@ -382,6 +531,11 @@ def main(argv=None):
     print(f"  continuity: {'bit-exact' if report['continuity_ok'] else 'BROKEN'}"
           f" ({report['rows']} rows) | quarantined: {report['quarantined']}"
           f" | retries: {report['telemetry_counts']['ckpt_io_retry']}")
+    el = report.get("elastic") or {}
+    print(f"  elastic: transitions {el.get('transitions')} | "
+          f"{el.get('bitexact_rows')} bit-exact rows, max rel diff "
+          f"{el.get('max_rel_diff')} (tol {el.get('rtol')}) | doctor "
+          f"{el.get('doctor_classification')}")
     if report["violations"]:
         for v in report["violations"]:
             print(f"  VIOLATION: {v}")

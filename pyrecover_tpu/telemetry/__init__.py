@@ -28,6 +28,18 @@ Core event names across the stack (fields beyond the envelope):
     ckpt_prune        engine, count, removed
     ckpt_pruned       engine, path, step (one per retention removal)
     resume            path, step, seconds; resume_replay: replayed_steps
+    elastic_resume    path, step, saved_topology, target_topology,
+                      resharded_leaves, plan_bytes_moved (a checkpoint was
+                      restored onto a DIFFERENT topology; the restore ran
+                      inside a `reshard` span)
+    elastic_preflight_failed  path, reason (shardcheck rejected the
+                      reshard plan — SC11/SC05 — before any restore I/O;
+                      resume falls back to an older fitting checkpoint)
+    topology_mismatch path, reason (--elastic-resume off and the saved
+                      topology differs: TopologyMismatchError follows)
+    sampler_rescaled  saved_replicas, target_replicas, consumed (the data
+                      pipeline re-derived its per-replica split; global
+                      sample order preserved exactly)
     preempt_check     step, time_left_s, threshold_s
     preempt_notice / preempt_stop / preempt_estimate
     preempt_signal_escalation  signal, count, step (2nd signal mid-save)

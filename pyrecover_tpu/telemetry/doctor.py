@@ -11,6 +11,9 @@ Output: one classification —
     preemption        deadline/notice stop or the SIGTERM-escalation exit
     oom               the crash is a memory exhaustion (exception text or
                       HBM peak at/over budget)
+    mesh_mismatch     the restore was refused for topology reasons — a
+                      TopologyMismatchError (--elastic-resume off) or every
+                      candidate rejected by the elastic preflight (SC11/SC05)
     platform_fallback the run executed on CPU when an accelerator was
                       expected (probe fallback / $PYRECOVER_EXPECT_ACCELERATOR)
     recompile_storm   repeated train-step retraces silently ate throughput
@@ -40,8 +43,8 @@ from pyrecover_tpu.telemetry import flight
 from pyrecover_tpu.telemetry.sinks import read_events
 
 CLASSES = (
-    "healthy", "hang", "crash", "preemption", "oom", "platform_fallback",
-    "recompile_storm", "unknown",
+    "healthy", "hang", "crash", "preemption", "oom", "mesh_mismatch",
+    "platform_fallback", "recompile_storm", "unknown",
 )
 
 DEFAULT_RECOMPILE_STORM = 3
@@ -213,6 +216,19 @@ def analyze(evidence, *, recompile_storm_threshold=DEFAULT_RECOMPILE_STORM):
     for e in seg:
         if e.get("event") == "platform_fallback":
             finding("platform_fallback", e.get("reason", ""))
+    n_topology = counts.get("topology_mismatch", 0) + counts.get(
+        "elastic_preflight_failed", 0
+    )
+    for e in seg:
+        if e.get("event") in ("topology_mismatch", "elastic_preflight_failed"):
+            finding(e["event"], e.get("reason", ""))
+        elif e.get("event") == "elastic_resume":
+            finding(
+                "elastic_resume",
+                f"resharded {e.get('resharded_leaves')} leaves onto "
+                f"{(e.get('target_topology') or {}).get('devices', '?')} "
+                "devices",
+            )
     n_hangs = counts.get("hang_detected", 0)
     if n_hangs:
         silences = [
@@ -269,6 +285,21 @@ def analyze(evidence, *, recompile_storm_threshold=DEFAULT_RECOMPILE_STORM):
                  if e.get("event") == "preempt_stop"),
                 "stopped early for a final checkpoint",
             )
+    elif n_topology and (
+        summary is None or summary.get("status") == "error"
+    ):
+        # the restore was refused for topology reasons and the run never
+        # recovered: either the non-elastic path raised a typed
+        # TopologyMismatchError, or every candidate failed the elastic
+        # preflight (a successful later fallback would have produced a
+        # non-error summary, which routes past this rule)
+        cls = "mesh_mismatch"
+        detail = next(
+            (e.get("reason", "") for e in reversed(seg)
+             if e.get("event") in ("topology_mismatch",
+                                   "elastic_preflight_failed")),
+            "",
+        ) or "restore refused: checkpoint topology does not fit this mesh"
     elif (
         (summary is not None and summary.get("status") == "error")
         or bundle_reason in ("unhandled_exception", "thread_exception")
@@ -333,6 +364,7 @@ def analyze(evidence, *, recompile_storm_threshold=DEFAULT_RECOMPILE_STORM):
             "implicit_transfers": n_transfers,
             "platform_fallbacks": n_fallback,
             "hangs": n_hangs,
+            "topology_rejections": n_topology,
             "last_status": (summary or {}).get("status"),
         },
     }

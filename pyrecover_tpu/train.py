@@ -241,8 +241,19 @@ def _resume(config, exp_dir, state, sampler, sharded_ckptr, totals):  # jaxlint:
     silent fresh start would let retention pruning destroy the intact
     checkpoints it skipped. An explicitly named checkpoint also fails
     hard: the user asked for THAT file.
+
+    Topology-elastic resume (checkpoint/elastic.py): BEFORE any restore
+    I/O, host 0 diffs the candidate's saved topology (a header read)
+    against the live mesh. When they differ and ``--elastic-resume`` is
+    not off, a mandatory shardcheck preflight proves the reshard plan is
+    expressible (SC11) and fits the target HBM budget (SC05); a failed
+    preflight FALLS BACK to the newest checkpoint that does fit — without
+    quarantining, the checkpoint is intact, it just doesn't fit this
+    mesh. With ``--elastic-resume off`` a topology drift raises a typed
+    ``TopologyMismatchError`` naming both topologies.
     """
-    from pyrecover_tpu.checkpoint import precheck_ckpt_sharded
+    from pyrecover_tpu.checkpoint import elastic, precheck_ckpt_sharded
+    from pyrecover_tpu.checkpoint.elastic import TopologyMismatchError
     from pyrecover_tpu.checkpoint.vanilla import (
         CheckpointStructureError,
         precheck_ckpt_vanilla,
@@ -261,67 +272,127 @@ def _resume(config, exp_dir, state, sampler, sharded_ckptr, totals):  # jaxlint:
         if not candidates:
             log_host0("No checkpoint found in %s; starting fresh", exp_dir)
             return 0, state
+    rejected_preflight = []
     for cand in candidates:
         prechecked = False
-        if not explicit:
-            # host-0 verdict, agreed everywhere, BEFORE any collective:
-            # 1 = ok, 0 = corrupt (fall back), 2 = structure mismatch
-            # (wrong model config — fatal on EVERY candidate, raised on
-            # all hosts so nobody is left waiting in a collective)
-            verdict, reason = 1, ""
-            if jax.process_index() == 0:
-                try:
+        plan = None
+        # host-0 verdict, agreed everywhere, BEFORE any collective:
+        # 1 = ok, 0 = corrupt (fall back), 2 = structure mismatch
+        # (wrong model config — fatal on EVERY candidate, raised on
+        # all hosts so nobody is left waiting in a collective),
+        # 3 = elastic preflight infeasible (fall back, NO quarantine),
+        # 4 = topology mismatch with --elastic-resume off (fatal),
+        # 5 = ok with the elastic reshard path active
+        verdict, reason = 1, ""
+        if jax.process_index() == 0:
+            try:
+                gate, reason, plan = elastic.resume_gate(
+                    config.elastic_resume, cand, state
+                )
+                verdict = {
+                    elastic.GATE_OK: 1,
+                    elastic.GATE_ELASTIC: 5,
+                    elastic.GATE_INFEASIBLE: 3,
+                    elastic.GATE_MISMATCH: 4,
+                }[gate]
+                if verdict in (1, 5) and not explicit:
                     if config.sharded_checkpoint:
-                        ok, reason = precheck_ckpt_sharded(cand, state)
+                        ok, why = precheck_ckpt_sharded(cand, state)
                     else:
                         # target_state activates the manifest schema diff:
                         # a wrong-model resume dies on a header read here,
                         # not minutes later mid-restore
-                        ok, reason = precheck_ckpt_vanilla(
+                        ok, why = precheck_ckpt_vanilla(
                             cand, verify=config.verify_checkpoints,
                             target_state=state,
                         )
-                    verdict = 1 if ok else 0
-                except CheckpointStructureError as e:
-                    verdict, reason = 2, str(e)
-            verdict = int(broadcast_host0_scalar(verdict))
-            if verdict == 2:
-                raise CheckpointStructureError(
-                    f"checkpoint {cand} does not fit the configured "
-                    f"model{': ' + reason if reason else ''}"
-                )
-            if verdict == 0:
-                log_host0(
-                    "Checkpoint %s failed integrity pre-check (%s); "
-                    "falling back to the previous one", cand, reason,
-                    level=30,  # WARNING
-                )
-                telemetry.emit(
-                    "ckpt_precheck_failed", path=str(cand), reason=reason
-                )
-                # move the corpse into .corrupt/ (host 0; atomic rename):
-                # the next restart must not re-discover and re-skip it,
-                # and retention must never count it against max_keep. The
-                # fallback verdict was already broadcast, so every host
-                # agrees this candidate is dead before the move happens.
-                if jax.process_index() == 0:
-                    quarantine_checkpoint(cand, reason=reason)
-                continue
-            prechecked = True
+                    if not ok:
+                        verdict, reason = 0, why
+            except CheckpointStructureError as e:
+                verdict, reason = 2, str(e)
+        verdict = int(broadcast_host0_scalar(verdict))
+        if verdict == 2:
+            raise CheckpointStructureError(
+                f"checkpoint {cand} does not fit the configured "
+                f"model{': ' + reason if reason else ''}"
+            )
+        if verdict == 4:
+            # loud + diagnosable: the typed error names both topologies
+            # (the doctor reads the event as a mesh_mismatch)
+            telemetry.emit(
+                "topology_mismatch", path=str(cand), reason=reason,
+                elastic_resume=config.elastic_resume,
+            )
+            raise TopologyMismatchError(path=cand, message=(
+                reason or f"checkpoint {cand} was saved on a different "
+                "topology than the live mesh (--elastic-resume off)"
+            ))
+        if verdict == 3:
+            telemetry.emit(
+                "elastic_preflight_failed", path=str(cand), reason=reason,
+            )
+            if explicit:
+                # the user asked for THAT checkpoint; it cannot fit here
+                raise TopologyMismatchError(path=cand, detail=reason or (
+                    "elastic preflight rejected the reshard plan"
+                ))
+            log_host0(
+                "Checkpoint %s cannot be resharded onto this mesh (%s); "
+                "falling back to the previous one", cand, reason,
+                level=30,  # WARNING
+            )
+            # NOT quarantined: the checkpoint is intact and will fit
+            # again when matching capacity returns
+            rejected_preflight.append(cand)
+            continue
+        if verdict == 0:
+            log_host0(
+                "Checkpoint %s failed integrity pre-check (%s); "
+                "falling back to the previous one", cand, reason,
+                level=30,  # WARNING
+            )
+            telemetry.emit(
+                "ckpt_precheck_failed", path=str(cand), reason=reason
+            )
+            # move the corpse into .corrupt/ (host 0; atomic rename):
+            # the next restart must not re-discover and re-skip it,
+            # and retention must never count it against max_keep. The
+            # fallback verdict was already broadcast, so every host
+            # agrees this candidate is dead before the move happens.
+            if jax.process_index() == 0:
+                quarantine_checkpoint(cand, reason=reason)
+            continue
+        prechecked = not explicit
+        elastic_active = verdict == 5
+        reshard_span = (
+            telemetry.span(
+                "reshard", path=str(cand), metric="reshard_s",
+            ) if elastic_active else contextlib.nullcontext()
+        )
         try:
-            if config.sharded_checkpoint:
-                state, sampler_meta, meta = sharded_ckptr.restore(cand, state)
-            else:
-                # single-process: the pre-check just checksummed the same
-                # bytes — don't pay a second verification pass (multi-host
-                # keeps the in-load verify: hosts != 0 read the file
-                # themselves)
-                verify = config.verify_checkpoints and not (
-                    prechecked and jax.process_count() == 1
-                )
-                state, sampler_meta, meta = load_ckpt_vanilla(
-                    cand, state, verify=verify
-                )
+            with reshard_span:
+                if config.sharded_checkpoint:
+                    # per-leaf reads with the TARGET shardings (not the
+                    # saved ones): Orbax range-reads each leaf straight
+                    # into its target shards — the sharded engine's
+                    # reshard execution
+                    state, sampler_meta, meta = sharded_ckptr.restore(
+                        cand, state
+                    )
+                else:
+                    # single-process: the pre-check just checksummed the
+                    # same bytes — don't pay a second verification pass
+                    # (multi-host keeps the in-load verify: hosts != 0
+                    # read the file themselves). Elastic execution for
+                    # this engine: full global leaves on every host,
+                    # device_put onto the target shardings (reslice +
+                    # scatter).
+                    verify = config.verify_checkpoints and not (
+                        prechecked and jax.process_count() == 1
+                    )
+                    state, sampler_meta, meta = load_ckpt_vanilla(
+                        cand, state, verify=verify
+                    )
         except Exception as e:
             if (
                 explicit
@@ -349,6 +420,45 @@ def _resume(config, exp_dir, state, sampler, sharded_ckptr, totals):  # jaxlint:
             )
             continue
         start_step = int(meta.get("step", int(np.asarray(state.step))))
+        if elastic_active:
+            # the reshard happened: account for it in the event stream.
+            # Plan accounting exists on host 0 (where the gate ran); the
+            # event is host-stamped like every other emit.
+            if jax.process_index() == 0 and plan is not None:
+                telemetry.emit(
+                    "elastic_resume", path=str(cand), step=start_step,
+                    saved_topology=plan.saved_topology,
+                    target_topology=plan.target_topology,
+                    resharded_leaves=plan.resharded_leaves,
+                    plan_bytes_moved=plan.bytes_moved,
+                )
+            # data-pipeline rescale: the sampler's order is a pure
+            # function of (seed, epoch, cursor), so re-deriving the
+            # per-replica split for the new replica count preserves the
+            # global sample sequence exactly — proven by the merge/split
+            # round-trip (preflight already established feasibility)
+            saved_replicas = int(sampler_meta.get("replicas", 0) or 0)
+            live_replicas = 0
+            if jax.process_index() == 0 and plan is not None:
+                tgt_mesh = plan.target_topology.get("mesh") or {}
+                live_replicas = int(tgt_mesh.get("data", 1)) * int(
+                    tgt_mesh.get("fsdp", 1)
+                )
+            if saved_replicas and live_replicas and (
+                saved_replicas != live_replicas
+            ):
+                from pyrecover_tpu.data.sampler import rescale_sampler_state
+
+                rescale_sampler_state(
+                    {k: v for k, v in sampler_meta.items()
+                     if k not in ("consumed", "replicas")},
+                    live_replicas,
+                )
+                telemetry.emit(
+                    "sampler_rescaled", saved_replicas=saved_replicas,
+                    target_replicas=live_replicas,
+                    consumed=int(sampler_meta.get("consumed", start_step)),
+                )
         sampler.seek(sampler_meta.get("consumed", start_step))
         totals.ckpt_load_s += time.monotonic() - t0
         log_host0(
@@ -362,10 +472,20 @@ def _resume(config, exp_dir, state, sampler, sharded_ckptr, totals):  # jaxlint:
         return start_step, state
     # refuse to run: a fresh start would save new checkpoints and retention
     # pruning would then delete the (possibly still recoverable) old ones
+    detail = ""
+    if rejected_preflight:
+        from pathlib import Path
+
+        names = ", ".join(Path(p).name for p in rejected_preflight[:4])
+        detail = (
+            f" ({len(rejected_preflight)} rejected by the elastic "
+            f"preflight for this topology: {names} — they are intact and "
+            "will restore when matching capacity returns)"
+        )
     raise RuntimeError(
-        f"every checkpoint in {exp_dir} failed to restore; refusing to "
-        "start fresh over existing checkpoints — inspect them with "
-        "tools/inspect_checkpoint.py or move them aside"
+        f"every checkpoint in {exp_dir} failed to restore{detail}; "
+        "refusing to start fresh over existing checkpoints — inspect "
+        "them with tools/inspect_checkpoint.py or move them aside"
     )
 
 
@@ -527,7 +647,16 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
             NamedSharding(mesh, P()),
         )
         state_to_save = dataclasses.replace(state, epoch=epoch)
-        sampler_meta = {"consumed": int(step), **sampler.state_dict()}
+        # "replicas": how many ways the batch axis is sharded right now —
+        # the elastic-resume preflight proves the sampler can rescale to a
+        # different replica count before any restore is attempted
+        mesh_shape = dict(mesh.shape)
+        sampler_meta = {
+            "consumed": int(step),
+            "replicas": int(mesh_shape.get("data", 1))
+            * int(mesh_shape.get("fsdp", 1)),
+            **sampler.state_dict(),
+        }
         extra = {"step": int(step), "epoch": sampler_epoch_of(step)}
         # while the save is in flight a FIRST signal defers exit until the
         # commit completes (the normal deferred-exit path); a SECOND one

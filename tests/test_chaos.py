@@ -387,4 +387,12 @@ def test_chaos_smoke_soak_bitexact(tmp_path):
     assert report["hang"]["bundles"]
     assert report["hang"]["doctor_classification"] == "hang"
     assert report["hang"]["doctor_phase"] == "loader_wait"
+    # ISSUE 7 elastic_shrink drill: kill at 4 devices → resume at 2 → grow
+    # back to 4, loss-continuity gated (bit-exact before the shrink,
+    # tolerance-aware after) with the elastic_resume telemetry present
+    el = report["elastic"]
+    assert (4, 2) in el["transitions"] and (2, 4) in el["transitions"]
+    assert el["bitexact_rows"] >= 1
+    assert el["max_rel_diff"] <= el["rtol"]
+    assert el["doctor_classification"] == "healthy"
     assert (tmp_path / "report.json").exists()

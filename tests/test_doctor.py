@@ -1,7 +1,8 @@
 """Doctor classification tests (pyrecover_tpu/telemetry/doctor.py).
 
 The classification table — healthy / hang / crash / preemption / oom /
-platform_fallback / recompile_storm / unknown — over synthetic telemetry
+mesh_mismatch / platform_fallback / recompile_storm / unknown — over
+synthetic telemetry
 streams and real flight bundles, phase naming from open spans, the
 last-segment-wins rule, exit codes, and the CLI (--json / --expect).
 """
@@ -141,6 +142,54 @@ def test_oom_from_hbm_budget(tmp_path):
     rep = doctor.diagnose(root)
     assert rep["classification"] == "oom"
     assert "106.25" in rep["detail"]
+
+
+def test_mesh_mismatch_from_topology_event(tmp_path):
+    # --elastic-resume off: the typed TopologyMismatchError path emits a
+    # topology_mismatch event before raising; the run dies with it
+    root = exp_with(tmp_path, [
+        RUN_START,
+        {"event": "topology_mismatch",
+         "reason": "checkpoint ckpt_8.ckpt was saved on 8 devices "
+                   "(data8, 1 process) but this run is on 4 devices"},
+        summary(status="error", step=0),
+    ])
+    rep = doctor.diagnose(root)
+    assert rep["classification"] == "mesh_mismatch"
+    assert "8 devices" in rep["detail"]
+    assert doctor.exit_code(rep) == 1
+
+
+def test_mesh_mismatch_when_every_candidate_rejected(tmp_path):
+    # elastic preflight rejected every candidate (SC11/SC05) and the run
+    # never produced a summary: the restore was refused, not a crash
+    root = exp_with(tmp_path, [
+        RUN_START,
+        {"event": "elastic_preflight_failed", "path": "ckpt_6.ckpt",
+         "reason": "SC05: state needs 3.1 GiB/device, over budget"},
+        {"event": "elastic_preflight_failed", "path": "ckpt_3.ckpt",
+         "reason": "SC05: state needs 3.1 GiB/device, over budget"},
+    ])
+    rep = doctor.diagnose(root)
+    assert rep["classification"] == "mesh_mismatch"
+    assert rep["evidence"]["topology_rejections"] == 2
+
+
+def test_elastic_fallback_that_recovered_is_healthy(tmp_path):
+    # one candidate was rejected but an older one fit and the run
+    # finished: that's a healthy run with an elastic footnote
+    root = exp_with(tmp_path, [
+        RUN_START,
+        {"event": "elastic_preflight_failed", "path": "ckpt_6.ckpt",
+         "reason": "SC11: global batch size 8 not divisible"},
+        {"event": "elastic_resume", "resharded_leaves": 12,
+         "target_topology": {"devices": 2}},
+        summary(),
+    ])
+    rep = doctor.diagnose(root)
+    assert rep["classification"] == "healthy"
+    kinds = {f["kind"] for f in rep["findings"]}
+    assert {"elastic_preflight_failed", "elastic_resume"} <= kinds
 
 
 def test_platform_fallback(tmp_path):

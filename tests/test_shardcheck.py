@@ -320,7 +320,7 @@ def test_sharded_precheck_uses_manifest(tmp_path):
 
 
 def test_check_catalog_complete():
-    assert set(CHECKS) == {f"SC{i:02d}" for i in range(1, 11)}
+    assert set(CHECKS) == {f"SC{i:02d}" for i in range(1, 12)}
     names = [v[0] for v in CHECKS.values()]
     assert len(names) == len(set(names))
 

@@ -3,12 +3,23 @@
 data-order state, leaf count/shapes/dtypes/pspecs/bytes.
 
 Usage: python tools/inspect_checkpoint.py PATH [--leaves] [--manifest]
+       python tools/inspect_checkpoint.py PATH --reshard-plan --devices N
+           [--mesh data=2,fsdp=2] [--json]
 
 ``--manifest`` prints the checkpoint's schema manifest as JSON — the
 exact document ``pyrecover_tpu.analysis.shardcheck`` diffs at preflight/
 resume (``shardcheck --diff-checkpoint``), read from the meta header
 alone (no tensor data). The human ``--leaves`` listing renders the same
 manifest, so the two surfaces cannot drift.
+
+``--reshard-plan --devices N`` dry-runs a topology-elastic resume onto
+an N-device mesh from the manifest alone — per-leaf source→target shard
+mapping (keep/split/concat/regrid), saved shards each target shard must
+read, bytes moved, and the shardcheck preflight verdict (SC11
+reshard-infeasible / SC05 hbm-over-budget) — no devices needed. The
+target mesh defaults to pure data parallelism; ``--mesh`` overrides axis
+sizes (``data=2,fsdp=2,tensor=2``; ``data=-1`` = all remaining). Exit 0
+when the plan is feasible, 1 when the preflight rejects it.
 """
 
 import argparse
@@ -158,13 +169,91 @@ def inspect_sharded(path, show_leaves):
     _print_manifest_rows(read_ckpt_manifest(path), show_leaves)
 
 
-def main(argv=None):
-    # behave like a unix tool when piped into head & co.
+def _parse_mesh_arg(mesh_arg, n_devices):
+    """``data=2,fsdp=2`` → a resolved ``{axis: size}`` dict over
+    ``n_devices`` virtual devices (no device objects involved)."""
+    from pyrecover_tpu.parallel.mesh import MESH_AXES, MeshConfig
+
+    kwargs = {}
+    if mesh_arg:
+        alias = {"tensor": "tensor", "tp": "tensor", "dp": "data",
+                 "data": "data", "fsdp": "fsdp", "sp": "sequence",
+                 "sequence": "sequence", "pp": "pipeline",
+                 "pipeline": "pipeline", "ep": "expert", "expert": "expert"}
+        for part in mesh_arg.split(","):
+            k, _, v = part.partition("=")
+            key = alias.get(k.strip())
+            if key is None or not v:
+                raise ValueError(
+                    f"bad --mesh entry {part!r}: want axis=size with axis "
+                    f"one of {sorted(set(alias))}"
+                )
+            kwargs[key] = int(v)
+    shape = MeshConfig(**kwargs).resolve(n_devices)
+    return dict(zip(MESH_AXES, shape))
+
+
+def reshard_plan_main(path, devices, mesh_arg, as_json):
+    from pyrecover_tpu.checkpoint import elastic
+
+    try:
+        meta = elastic.read_saved_meta(path)
+    except Exception as e:
+        print(f"ERROR: cannot read checkpoint meta: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+    from pyrecover_tpu.analysis.shardcheck.manifest import (
+        manifest_from_ckpt_meta,
+        read_ckpt_manifest,
+    )
+
+    manifest = (
+        meta.get("manifest") if isinstance(meta, dict) else None
+    ) or (manifest_from_ckpt_meta(meta) if meta.get("leaves")
+          else read_ckpt_manifest(path))
+    try:
+        target_mesh = _parse_mesh_arg(mesh_arg, devices)
+    except ValueError as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 2
+    saved_topo = meta.get("topology")
+    target_topo = {"devices": int(devices), "processes": 1,
+                   "mesh": target_mesh}
+    findings, plan = elastic.preflight_elastic(
+        manifest, saved_topo, target_topo,
+        sampler_state=meta.get("sampler") or {},
+        locus=Path(path).name,
+    )
+    if as_json:
+        doc = plan.as_dict()
+        doc["findings"] = [
+            {"id": f.rule_id, "rule": f.rule, "severity": f.severity,
+             "message": f.message}
+            for f in findings
+        ]
+        print(json.dumps(doc, indent=2))
+    else:
+        from pyrecover_tpu.checkpoint.elastic import render_plan
+
+        render_plan(plan, sys.stdout)
+        for f in findings:
+            print(f"  {f.rule_id} [{f.severity}] {f.message}")
+    return 0 if not findings else 1
+
+
+def _die_quietly_on_sigpipe():
+    """Behave like a unix tool when piped into head & co. Script-entry
+    only: main() is also called IN-PROCESS by tests, and resetting the
+    process-wide SIGPIPE disposition there turns any later closed-socket
+    write in the host process into a silent kill."""
     import contextlib
     import signal as _signal
 
     with contextlib.suppress(Exception):
         _signal.signal(_signal.SIGPIPE, _signal.SIG_DFL)
+
+
+def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("checkpoint")
     ap.add_argument("--leaves", action="store_true", help="list every leaf")
@@ -173,11 +262,30 @@ def main(argv=None):
         help="print the schema manifest JSON (paths/shapes/dtypes/pspecs) "
         "— the document shardcheck diffs; header read only",
     )
+    ap.add_argument(
+        "--reshard-plan", action="store_true",
+        help="dry-run a topology-elastic restore onto --devices N: "
+        "per-leaf source→target shard mapping, bytes moved, and the "
+        "shardcheck preflight verdict — from the manifest alone",
+    )
+    ap.add_argument("--devices", type=int, default=None,
+                    help="target device count for --reshard-plan")
+    ap.add_argument("--mesh", type=str, default="",
+                    help="target mesh axis sizes for --reshard-plan, e.g. "
+                    "data=2,fsdp=2 (default: pure data parallelism)")
+    ap.add_argument("--json", action="store_true",
+                    help="with --reshard-plan: emit the plan as JSON")
     args = ap.parse_args(argv)
     p = Path(args.checkpoint)
     if not p.exists():
         print(f"ERROR: {p} does not exist", file=sys.stderr)
         return 2
+    if args.reshard_plan:
+        if not args.devices:
+            print("ERROR: --reshard-plan requires --devices N",
+                  file=sys.stderr)
+            return 2
+        return reshard_plan_main(p, args.devices, args.mesh, args.json)
     if args.manifest:
         from pyrecover_tpu.analysis.shardcheck.manifest import (
             read_ckpt_manifest,
@@ -197,4 +305,5 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
+    _die_quietly_on_sigpipe()
     sys.exit(main())
