@@ -128,6 +128,13 @@ def main():
                     choices=["auto", "grouped", "einsum", "scatter"],
                     help="MoE dispatch backend (A/B the grouped ragged-GEMM "
                          "path against the r3 einsum/scatter backends)")
+    ap.add_argument("--write-ckpt-baseline", default=None,
+                    help="write a traceview-format checkpoint-phase "
+                         "baseline JSON ({phase_key: p50_s}) from this "
+                         "run's measured save timings — the artifact "
+                         "committed at baselines/ckpt_phase_bench_"
+                         "baseline.json that pins the zerostall blocking-"
+                         "vs-vanilla-full-save ratio on the bench state")
     ap.add_argument("--require-accelerator", action="store_true",
                     default=os.environ.get("BENCH_REQUIRE_ACCELERATOR") == "1",
                     help="refuse to publish a number if the run resolved to "
@@ -402,6 +409,77 @@ def main():
                     nbytes / max(read_s, 1e-9) / 1e9, 3
                 ),
             })
+            # -- zerostall: blocking window + chunk dedup ------------------
+            # save twice: the first save pays the full chunk-store write,
+            # the second (unchanged state) dedups to ~zero bytes — and the
+            # blocking window stays snapshot-sized both times. The
+            # emergency tier is off here (it would pin a full state copy
+            # in the bench host's RAM for no measurement value).
+            from pyrecover_tpu.checkpoint.zerostall import (
+                chunkstore as zs_chunkstore,
+                save_ckpt_zerostall,
+            )
+
+            zs_exp = tmp / "zs"
+            b1, h1 = save_ckpt_zerostall(
+                zs_exp / "ckpt_1.zs.json", ckpt_state, {"consumed": 1},
+                extra_meta={"step": 1}, background=True,
+                emergency_tier=False,
+            )
+            h1.wait()
+            b2, h2 = save_ckpt_zerostall(
+                zs_exp / "ckpt_2.zs.json", ckpt_state, {"consumed": 2},
+                extra_meta={"step": 2}, background=True,
+                emergency_tier=False,
+            )
+            h2.wait()
+            reuse = zs_chunkstore.read_manifest(
+                zs_exp / "ckpt_2.zs.json"
+            )["reuse"]
+            ck.update({
+                "zerostall_blocking_s": round(b1, 4),
+                "zerostall_blocking2_s": round(b2, 4),
+                "zerostall_shadow_s": round(h1.shadow_s, 2),
+                "zerostall_dedup_bytes_written": reuse["bytes_written"],
+                "zerostall_dedup_bytes_reused": reuse["bytes_reused"],
+            })
+
+            # ckpt_blocking_s distribution across the engines measured
+            # above — the same histogram the train loop feeds, so the
+            # BENCH JSON's p50/total and a real run's telemetry agree on
+            # what "blocking save time" means (the perf trajectory's
+            # stall-shrinking signal across rounds)
+            blocking_sink = telemetry.add_sink(telemetry.MemorySink())
+            blocking_hist = telemetry.metrics.histogram("ckpt_blocking_s")
+            for v in (blocking_s, write_s, b1, b2):
+                blocking_hist.observe(v)
+            telemetry.metrics.flush(reason="bench_ckpt")
+            bsnap = next(
+                (e for e in reversed(blocking_sink.events)
+                 if e["event"] == "metrics_snapshot"), {},
+            )
+            bh = (bsnap.get("hists") or {}).get("ckpt_blocking_s") or {}
+            telemetry.remove_sink(blocking_sink)
+            ck["ckpt_blocking_p50_s"] = bh.get("p50")
+            ck["ckpt_blocking_total_s"] = round(
+                blocking_s + write_s + b1 + b2, 4
+            )
+            if args.write_ckpt_baseline:
+                # traceview-format {phase_key: p50_s}: the vanilla full
+                # save vs the zerostall blocking window, ON THE SAME
+                # STATE — the committed proof of the stall reduction
+                baseline = {
+                    "vanilla:ckpt_save": round(write_s + d2h_s, 6),
+                    "zerostall:ckpt_blocking": round(min(b1, b2), 6),
+                    "zerostall:ckpt_shadow": round(h1.shadow_s, 6),
+                }
+                Path(args.write_ckpt_baseline).parent.mkdir(
+                    parents=True, exist_ok=True
+                )
+                Path(args.write_ckpt_baseline).write_text(
+                    json.dumps(baseline, indent=2)
+                )
+
             ck["host_cpu_cores"] = os.cpu_count()
             ck["note"] = (
                 "every rate here is environment-bound, not engine-bound: "

@@ -112,6 +112,28 @@ else
   rc=1
 fi
 
+# checkpoint-phase regression gate (ROADMAP item 1's gate, now wired into
+# the build): traceview diffs the chaos soak's checkpoint-phase p50s —
+# the zerostall drill's ckpt_blocking/ckpt_snapshot/... spans and the
+# main drill's vanilla ckpt_save — against the baseline COMMITTED in the
+# repo (baselines/ckpt_phase_baseline.json, which also pins the >=5x
+# zerostall-blocking-vs-vanilla-save ratio asserted in tests). A
+# blocking-save-time regression beyond 2.5x the stored p50 fails the
+# build; the generous tolerance absorbs CI-machine noise while still
+# catching the failure mode that matters (the snapshot window silently
+# becoming a full synchronous save is a 10-100x move).
+if TVB_OUT=$(JAX_PLATFORMS=cpu python tools/traceview.py \
+    "$CHAOS_WORK"/zs/zs_telemetry.jsonl \
+    "$CHAOS_WORK"/zs_golden/zs_golden_telemetry.jsonl \
+    "$CHAOS_WORK"/chaos/chaos_telemetry.jsonl \
+    --baseline baselines/ckpt_phase_baseline.json \
+    --regression-tolerance 1.5 2>&1); then
+  echo "ckpt-phase baseline: OK (no regression vs baselines/ckpt_phase_baseline.json)"
+else
+  echo "$TVB_OUT" | grep -E "REGRESSION|error" || echo "$TVB_OUT" | tail -5
+  rc=1
+fi
+
 # doctor smoke: the crash-forensics gate (pyrecover_tpu/telemetry/doctor).
 # Classifies the chaos workdir's artifacts (postmortem bundles + telemetry
 # shards the soak just produced): the recovered main experiment must read

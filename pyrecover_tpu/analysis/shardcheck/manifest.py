@@ -136,6 +136,12 @@ def read_ckpt_manifest(path):
             for p, x in flat
         ]
         return {"schema": 0, "num_leaves": len(leaves), "leaves": leaves}
+    from pyrecover_tpu.checkpoint.registry import ZEROSTALL_SUFFIX
+
+    if path.name.endswith(ZEROSTALL_SUFFIX):
+        # zerostall manifest file: the schema manifest is embedded
+        # verbatim (the whole document is metadata, no tensor bytes)
+        return manifest_from_ckpt_meta(json.loads(path.read_text()))
     from pyrecover_tpu.checkpoint.vanilla import read_ckpt_meta
 
     return manifest_from_ckpt_meta(read_ckpt_meta(path, check_version=False))

@@ -1,5 +1,6 @@
 from pyrecover_tpu.checkpoint.registry import (
     checkpoint_path,
+    engine_of,
     get_latest_checkpoint,
     list_checkpoints,
     prune_checkpoints,
@@ -18,9 +19,15 @@ from pyrecover_tpu.checkpoint.elastic import (
     read_saved_meta,
     topologies_differ,
 )
+from pyrecover_tpu.checkpoint.zerostall import (
+    load_ckpt_zerostall,
+    precheck_ckpt_zerostall,
+    save_ckpt_zerostall,
+)
 
 __all__ = [
     "checkpoint_path",
+    "engine_of",
     "get_latest_checkpoint",
     "list_checkpoints",
     "prune_checkpoints",
@@ -35,4 +42,7 @@ __all__ = [
     "preflight_elastic",
     "read_saved_meta",
     "topologies_differ",
+    "save_ckpt_zerostall",
+    "load_ckpt_zerostall",
+    "precheck_ckpt_zerostall",
 ]

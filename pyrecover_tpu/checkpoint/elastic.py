@@ -112,12 +112,18 @@ def topologies_differ(saved, target):
 def read_saved_meta(path):
     """Light metadata read for the elastic gate — O(meta) bytes, never
     tensor data. Vanilla single file: the v2 framed header. Sharded
-    directory: the Orbax ``meta`` JSON item. Returns the meta dict
-    (``topology`` / ``manifest`` / ``sampler`` keys when present)."""
+    directory: the Orbax ``meta`` JSON item. Zerostall manifest: the
+    whole document IS metadata (chunk digests, no tensor bytes). Returns
+    the meta dict (``topology`` / ``manifest`` / ``sampler`` keys when
+    present)."""
     path = Path(path)
     if path.is_dir():
         meta_file = path / "meta" / "metadata"
         return json.loads(meta_file.read_text()) if meta_file.exists() else {}
+    from pyrecover_tpu.checkpoint.registry import ZEROSTALL_SUFFIX
+
+    if path.name.endswith(ZEROSTALL_SUFFIX):
+        return json.loads(path.read_text())
     from pyrecover_tpu.checkpoint.vanilla import read_ckpt_meta
 
     return read_ckpt_meta(path, check_version=False)

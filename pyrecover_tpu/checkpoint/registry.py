@@ -6,7 +6,12 @@ Layout parity with the reference (train.py:135-141, 309-315, 348-353):
 
 Vanilla checkpoints are single *files* (`.ckpt`); sharded checkpoints are
 *directories* — exactly the reference's file/dir split (checkpoint.py:
-371-404). Two deliberate fixes over the reference (SURVEY §2.3):
+371-404); zerostall checkpoints are manifest files (`.zs.json`) whose
+tensor data lives in the content-addressed ``chunks/`` store beside them
+(checkpoint/zerostall/). Engines can coexist in one experiment
+directory: discovery, `latest`, and retention are engine-scoped via
+``engine_of`` so one engine's pruning can never eat another's
+checkpoints. Two deliberate fixes over the reference (SURVEY §2.3):
 
   * defect #6 — vanilla retention pruned by lexicographic name sort, so
     `ckpt_1000.pt` sorted before `ckpt_200.pt` and the wrong checkpoint was
@@ -22,18 +27,48 @@ from pathlib import Path
 
 from pyrecover_tpu.resilience.quarantine import QUARANTINE_DIRNAME
 
-_CKPT_RE = re.compile(r"^ckpt_(\d+)(_final)?(\.ckpt)?$")
+_CKPT_RE = re.compile(r"^ckpt_(\d+)(_final)?(\.ckpt|\.zs\.json)?$")
 
 VANILLA_SUFFIX = ".ckpt"
+ZEROSTALL_SUFFIX = ".zs.json"
+
+ENGINES = ("vanilla", "sharded", "zerostall")
+
+
+def engine_of(path):
+    """Which engine owns a checkpoint path: directories are sharded
+    (Orbax), ``.zs.json`` manifests are zerostall, everything else is a
+    vanilla single file."""
+    path = Path(path)
+    if path.is_dir():
+        return "sharded"
+    if path.name.endswith(ZEROSTALL_SUFFIX):
+        return "zerostall"
+    return "vanilla"
+
+
+def _resolve_engine(sharded, engine):
+    """One engine name from the legacy ``sharded`` tristate and the
+    explicit ``engine`` parameter (which wins). None = all engines."""
+    if engine is not None:
+        if engine not in ENGINES:
+            raise ValueError(f"unknown checkpoint engine {engine!r}")
+        return engine
+    if sharded is None:
+        return None
+    return "sharded" if sharded else "vanilla"
 
 
 def checkpoint_path(checkpoint_dir, experiment_name, step, *, final=False,
-                    sharded=False):
+                    sharded=False, engine=None):
+    engine = _resolve_engine(sharded, engine) or "vanilla"
     name = f"ckpt_{int(step)}"
     if final:
         name += "_final"
-    if not sharded:
+    if engine == "vanilla":
         name += VANILLA_SUFFIX
+    elif engine == "zerostall":
+        name += ZEROSTALL_SUFFIX
     return Path(checkpoint_dir) / experiment_name / name
 
 
@@ -43,13 +78,16 @@ def parse_step(path):
     return int(m.group(1)) if m else None
 
 
-def list_checkpoints(exp_dir, *, sharded=None):
+def list_checkpoints(exp_dir, *, sharded=None, engine=None):
     """All checkpoints in ``exp_dir``, ordered oldest→newest by step.
 
-    ``sharded=True`` restricts to directories, ``False`` to files,
-    ``None`` returns both.
+    ``engine`` ("vanilla" | "sharded" | "zerostall") restricts to one
+    engine's checkpoints; the legacy ``sharded`` tristate maps True→
+    "sharded", False→"vanilla". With neither, every engine's checkpoints
+    are returned.
     """
     exp_dir = Path(exp_dir)
+    want = _resolve_engine(sharded, engine)
     if not exp_dir.is_dir():
         return []
     out = []
@@ -63,33 +101,36 @@ def list_checkpoints(exp_dir, *, sharded=None):
         step = parse_step(p)
         if step is None:
             continue
-        is_dir = p.is_dir()
-        if sharded is True and not is_dir:
-            continue
-        if sharded is False and is_dir:
+        if want is not None and engine_of(p) != want:
             continue
         out.append((step, p.stat().st_mtime, p))
     out.sort(key=lambda t: (t[0], t[1]))
     return [p for _, _, p in out]
 
 
-def get_latest_checkpoint(exp_dir, *, sharded=None):
+def get_latest_checkpoint(exp_dir, *, sharded=None, engine=None):
     """Newest checkpoint by step number (reference checkpoint.py:371-404,
     which used mtime — step numbers are the actual intent)."""
-    ckpts = list_checkpoints(exp_dir, sharded=sharded)
+    ckpts = list_checkpoints(exp_dir, sharded=sharded, engine=engine)
     return ckpts[-1] if ckpts else None
 
 
-def prune_checkpoints(exp_dir, max_keep, *, sharded=None):
+def prune_checkpoints(exp_dir, max_keep, *, sharded=None, engine=None):
     """Delete oldest checkpoints beyond ``max_keep`` (plus checksum
-    sidecars). Returns the deleted paths."""
+    sidecars). Returns the deleted paths.
+
+    Engine-scoped: with ``engine`` (or the legacy ``sharded`` flag) only
+    that engine's checkpoints count against ``max_keep`` — retention on
+    one engine never deletes another's. For zerostall, removing a
+    manifest only drops references; the chunk bytes are reclaimed by
+    ``zerostall.chunkstore.collect_garbage`` (refcounted — a chunk any
+    live manifest still names is never collected)."""
     if max_keep is None or max_keep <= 0:
         return []
-    ckpts = list_checkpoints(exp_dir, sharded=sharded)
+    want = _resolve_engine(sharded, engine)
+    ckpts = list_checkpoints(exp_dir, engine=want)
     doomed = ckpts[:-max_keep] if len(ckpts) > max_keep else []
-    engine = (
-        "sharded" if sharded else "vanilla" if sharded is False else "any"
-    )
+    engine_label = want or "any"
     for p in doomed:
         if p.is_dir():
             shutil.rmtree(p, ignore_errors=True)
@@ -103,13 +144,14 @@ def prune_checkpoints(exp_dir, max_keep, *, sharded=None):
         # one event per removal: retention is destroying durable state, so
         # every deletion must be individually attributable in the stream
         telemetry.emit(
-            "ckpt_pruned", engine=engine, path=p.name, step=parse_step(p),
+            "ckpt_pruned", engine=engine_label, path=p.name,
+            step=parse_step(p),
         )
     if doomed:
         from pyrecover_tpu import telemetry
 
         telemetry.emit(
-            "ckpt_prune", engine=engine,
+            "ckpt_prune", engine=engine_label,
             count=len(doomed), removed=[p.name for p in doomed],
         )
     return doomed

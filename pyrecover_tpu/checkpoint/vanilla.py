@@ -180,6 +180,9 @@ class VanillaSaveHandle:
     def __init__(self, thread=None):
         self._thread = thread
         self.error = None
+        # background wall seconds the train loop did NOT pay for — the
+        # goodput ledger's ckpt_shadow_s feed (0 for synchronous saves)
+        self.shadow_s = 0.0
 
     def wait(self):
         if self._thread is not None:
@@ -271,10 +274,19 @@ def save_ckpt_vanilla(path, state, sampler_state=None, *, verify=False,
                     yield arr
 
             def _bg():
+                t_bg = time.monotonic()
                 try:
                     _write_stream(path, drain(), meta, verify, max_keep)
                 except BaseException as e:  # surfaced at wait()
                     handle.error = e
+                finally:
+                    handle.shadow_s = time.monotonic() - t_bg
+                    telemetry.emit(
+                        "ckpt_save_shadow", engine="vanilla",
+                        path=str(path),
+                        shadow_s=round(handle.shadow_s, 4),
+                        ok=handle.error is None,
+                    )
 
             t = threading.Thread(target=_bg, daemon=True)
             handle._thread = t

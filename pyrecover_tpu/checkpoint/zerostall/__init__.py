@@ -1,0 +1,40 @@
+"""Zero-stall checkpoint engine: async snapshot pipeline + content-
+addressed incremental chunk store + in-RAM emergency tier.
+
+The third checkpoint engine (``--checkpoint-engine zerostall``). Layout
+under the experiment directory::
+
+    <exp_dir>/ckpt_<step>[_final].zs.json    one manifest per checkpoint
+    <exp_dir>/chunks/<dd>/<digest>           content-addressed chunks
+
+``snapshot.py`` owns the save pipeline (donated-buffer-safe device→host
+snapshot overlapped with training, bounded in-flight queue with a loud
+``ckpt_backpressure`` event), ``chunkstore.py`` the incremental store +
+refcounted GC, ``emergency.py`` the in-RAM restore tier. See the README
+"Zero-stall checkpointing" section for the failure matrix.
+"""
+
+from pyrecover_tpu.checkpoint.zerostall import chunkstore, emergency
+from pyrecover_tpu.checkpoint.zerostall.chunkstore import (
+    collect_garbage,
+    read_manifest,
+    referenced_digests,
+)
+from pyrecover_tpu.checkpoint.zerostall.snapshot import (
+    ZerostallSaveHandle,
+    load_ckpt_zerostall,
+    precheck_ckpt_zerostall,
+    save_ckpt_zerostall,
+)
+
+__all__ = [
+    "chunkstore",
+    "emergency",
+    "save_ckpt_zerostall",
+    "load_ckpt_zerostall",
+    "precheck_ckpt_zerostall",
+    "ZerostallSaveHandle",
+    "collect_garbage",
+    "referenced_digests",
+    "read_manifest",
+]

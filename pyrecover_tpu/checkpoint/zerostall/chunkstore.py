@@ -1,0 +1,337 @@
+"""Content-addressed incremental chunk store for the zerostall engine.
+
+Every leaf's byte stream is split into fixed-size chunks addressed by a
+content digest under ``<exp_dir>/chunks/<digest[:2]>/<digest>``. A chunk
+that already exists costs ZERO bytes on the next save — embedding tables,
+frozen params, and late-training slow-movers dedup away — and a
+checkpoint is just a small manifest (``ckpt_<step>.zs.json``) mapping
+leaves to chunk digests, committed with one atomic rename. That gives
+three properties the single-file vanilla container cannot:
+
+  * **incremental saves** — the second save of a mostly-unchanged state
+    writes only the chunks whose content actually moved; the manifest's
+    per-leaf ``reused`` counts make the dedup auditable;
+  * **torn-save immunity by construction** — chunks are immutable once
+    written (same digest ⇒ same bytes) and the manifest rename is the
+    only commit point, so a kill at ANY earlier stage leaves every prior
+    manifest restorable and at worst some orphan chunks for GC;
+  * **refcounted garbage collection** (:func:`collect_garbage`) replaces
+    ``prune_checkpoints`` deletion semantics: a chunk is collected only
+    when NO live manifest — including quarantined ones under
+    ``.corrupt/`` (forensic evidence must stay restorable) — references
+    it.
+
+Digests are BLAKE2b-128 (stdlib, keyed content addressing); chunk reads
+re-verify the digest, so corruption is detected without checksum
+sidecars. The chunk size is fixed per manifest (``chunk_bytes`` is
+recorded), tunable via ``$PYRECOVER_ZS_CHUNK_BYTES``.
+"""
+
+import hashlib
+import json
+import os
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+from pyrecover_tpu import telemetry
+from pyrecover_tpu.resilience import faults
+from pyrecover_tpu.resilience.retry import io_retry
+
+ZS_FORMAT_VERSION = 1
+CHUNKS_DIRNAME = "chunks"
+CHUNK_BYTES_ENV = "PYRECOVER_ZS_CHUNK_BYTES"
+DEFAULT_CHUNK_BYTES = 4 * 1024 * 1024
+
+
+def chunk_bytes_default():
+    return int(os.environ.get(CHUNK_BYTES_ENV, DEFAULT_CHUNK_BYTES))
+
+
+def chunk_digest(data):  # jaxlint: host-only
+    """Content address of one chunk: BLAKE2b-128 hex (32 chars)."""
+    return hashlib.blake2b(data, digest_size=16).hexdigest()
+
+
+def chunks_root(exp_dir):
+    return Path(exp_dir) / CHUNKS_DIRNAME
+
+
+def chunk_path(root, digest):
+    # two-hex-char fan-out keeps directory listings sane at fleet scale
+    return Path(root) / digest[:2] / digest
+
+
+def split_chunks(view, chunk_bytes):  # jaxlint: host-only
+    """Yield fixed-size memoryview windows over a contiguous byte view."""
+    for off in range(0, len(view), chunk_bytes):
+        yield view[off : off + chunk_bytes]
+    if len(view) == 0:
+        # zero-byte leaves (rare but legal) still get one addressable chunk
+        yield view
+
+
+def leaf_chunk_digests(arr, chunk_bytes):  # jaxlint: host-only
+    """Chunk digests of a host array's byte stream — the same addresses a
+    save would produce; the emergency tier's strict freshness check and
+    the tests' dedup assertions both rekey through this."""
+    view = memoryview(np.ascontiguousarray(arr).view(np.uint8)).cast("B")
+    return [chunk_digest(c) for c in split_chunks(view, chunk_bytes)]
+
+
+class ChunkStore:
+    """Write-side handle over ``<exp_dir>/chunks/``. Tracks cumulative
+    written/reused byte accounting for the manifest's ``reuse`` record."""
+
+    def __init__(self, exp_dir):
+        self.root = chunks_root(exp_dir)
+        self.written_bytes = 0
+        self.reused_bytes = 0
+        self.written_chunks = 0
+        self.reused_chunks = 0
+
+    def put(self, data):  # jaxlint: host-only
+        """Store one chunk; returns its digest. An existing chunk with the
+        right size is a dedup hit and costs zero writes (same digest ⇒
+        same bytes — content addressing makes overwrites meaningless)."""
+        digest = chunk_digest(data)
+        dest = chunk_path(self.root, digest)
+        if dest.exists() and dest.stat().st_size == len(data):
+            self.reused_chunks += 1
+            self.reused_bytes += len(data)
+            return digest
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        path_s = str(dest)
+
+        def _write_once():
+            # the fault seam raises/kills BEFORE the real write (the
+            # vanilla ckpt_write seam's convention), so an injected fault
+            # never leaves a half-applied chunk behind the retry
+            faults.check(
+                "ckpt_chunk_write", path=path_s, written=self.written_bytes
+            )
+            fd, tmp = tempfile.mkstemp(dir=dest.parent, prefix=digest,
+                                       suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, dest)  # atomic: a chunk is whole or absent
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+
+        io_retry(_write_once, op="chunk_write", path=path_s)
+        self.written_chunks += 1
+        self.written_bytes += len(data)
+        # each landed chunk is checkpoint-writer progress for the
+        # run-health watchdog (no-op when none is active)
+        telemetry.watchdog.beat("ckpt_writer")
+        return digest
+
+    def get(self, digest, expected_len=None):  # jaxlint: host-only
+        """Read one chunk and re-verify its content digest (the store has
+        no checksum sidecars — the address IS the checksum)."""
+        path = chunk_path(self.root, digest)
+
+        def _read_once():
+            faults.check("ckpt_read", path=str(path))
+            return path.read_bytes()
+
+        data = io_retry(_read_once, op="read", path=str(path))
+        if expected_len is not None and len(data) != expected_len:
+            raise ValueError(
+                f"chunk {digest}: {len(data)} bytes on disk, expected "
+                f"{expected_len} — torn or foreign chunk"
+            )
+        actual = chunk_digest(data)
+        if actual != digest:
+            raise ValueError(
+                f"chunk {digest}: content digest {actual} does not match "
+                "its address — on-disk corruption"
+            )
+        return data
+
+    def reuse_stats(self):
+        return {
+            "chunks_total": self.written_chunks + self.reused_chunks,
+            "chunks_written": self.written_chunks,
+            "chunks_reused": self.reused_chunks,
+            "bytes_total": self.written_bytes + self.reused_bytes,
+            "bytes_written": self.written_bytes,
+            "bytes_reused": self.reused_bytes,
+        }
+
+
+def write_leaf(store, arr, chunk_bytes):  # jaxlint: host-only
+    """Chunk one host array into the store; returns (digests, reused)
+    where ``reused`` counts the chunks that were dedup hits."""
+    view = memoryview(np.ascontiguousarray(arr).view(np.uint8)).cast("B")
+    before = store.reused_chunks
+    digests = [store.put(bytes(c)) for c in split_chunks(view, chunk_bytes)]
+    return digests, store.reused_chunks - before
+
+
+def expected_chunk_sizes(nbytes, chunk_bytes):
+    """Per-chunk byte sizes a leaf of ``nbytes`` splits into."""
+    if nbytes == 0:
+        return [0]
+    sizes = [chunk_bytes] * (nbytes // chunk_bytes)
+    if nbytes % chunk_bytes:
+        sizes.append(nbytes % chunk_bytes)
+    return sizes
+
+
+def assemble_leaf(store, entry, dtype):  # jaxlint: host-only
+    """Reassemble one leaf's host array from its manifest entry, verifying
+    every chunk's digest on the way."""
+    sizes = expected_chunk_sizes(int(entry["nbytes"]),
+                                 int(entry["chunk_bytes"]))
+    if len(sizes) != len(entry["chunks"]):
+        raise ValueError(
+            f"{entry['path']}: manifest lists {len(entry['chunks'])} "
+            f"chunks, layout expects {len(sizes)}"
+        )
+    buf = bytearray(int(entry["nbytes"]))
+    off = 0
+    for digest, size in zip(entry["chunks"], sizes):
+        buf[off : off + size] = store.get(digest, expected_len=size)
+        off += size
+    count = (
+        int(np.prod(entry["shape"], dtype=np.int64)) if entry["shape"] else 1
+    )
+    arr = np.frombuffer(bytes(buf), dtype=dtype, count=count)
+    return arr.reshape(entry["shape"])
+
+
+# ---- manifest commit / read -------------------------------------------------
+
+
+def commit_manifest(path, doc):  # jaxlint: host-only
+    """Atomically publish a zerostall manifest: tmp write + fsync + one
+    ``os.replace``. The ``ckpt_manifest_commit`` fault seam sits BETWEEN
+    the durable tmp file and the rename — a kill there must leave the
+    previous manifest as the newest restorable checkpoint."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    payload = json.dumps(doc).encode()
+    path_s = str(path)
+
+    def _commit_once():
+        fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=path.name,
+                                   suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            # the pre-commit seam: everything durable, nothing published
+            faults.check("ckpt_manifest_commit", path=path_s)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+    io_retry(_commit_once, op="manifest_commit", path=path_s)
+    telemetry.watchdog.beat("ckpt_writer")
+    return len(payload)
+
+
+def read_manifest(path):
+    """Parse a ``.zs.json`` manifest. Raises on malformed/unsupported
+    documents — the precheck turns that into a fallback, not a crash."""
+    doc = json.loads(Path(path).read_text())
+    if doc.get("format") != ZS_FORMAT_VERSION:
+        raise ValueError(
+            f"unsupported zerostall manifest format {doc.get('format')!r}"
+        )
+    return doc
+
+
+# ---- garbage collection -----------------------------------------------------
+
+
+def _iter_manifests(exp_dir):
+    """Every manifest whose chunks must be retained: live checkpoints in
+    the experiment dir AND quarantined ones under ``.corrupt/`` — a
+    quarantined manifest is forensic evidence and must stay restorable
+    until someone deletes it deliberately."""
+    from pyrecover_tpu.checkpoint.registry import ZEROSTALL_SUFFIX
+    from pyrecover_tpu.resilience.quarantine import quarantine_dir
+
+    exp_dir = Path(exp_dir)
+    if exp_dir.is_dir():
+        for p in exp_dir.iterdir():
+            if p.is_file() and p.name.endswith(ZEROSTALL_SUFFIX):
+                yield p
+    qdir = quarantine_dir(exp_dir)
+    if qdir.is_dir():
+        for p in qdir.iterdir():
+            # collision-suffixed names (ckpt_3.zs.json.1) count too
+            if p.is_file() and ZEROSTALL_SUFFIX in p.name:
+                yield p
+
+
+def referenced_digests(exp_dir):
+    """The digest set any live (or quarantined) manifest references."""
+    refs = set()
+    for manifest in _iter_manifests(exp_dir):
+        try:
+            doc = json.loads(manifest.read_text())
+        except ValueError:
+            continue  # a torn manifest references nothing provable
+        for entry in doc.get("leaves", []):
+            refs.update(entry.get("chunks", []))
+    return refs
+
+
+def collect_garbage(exp_dir):  # jaxlint: host-only
+    """Refcounted chunk GC: remove every chunk file no live manifest
+    references. Safe against torn saves (orphan chunks from a killed
+    writer are exactly what this collects) and NEVER collects a chunk a
+    live or quarantined manifest still needs. Returns
+    ``(removed_count, removed_bytes)``."""
+    from pyrecover_tpu.checkpoint.registry import ZEROSTALL_SUFFIX
+
+    t0 = time.monotonic()
+    exp_dir = Path(exp_dir)
+    root = chunks_root(exp_dir)
+    # manifest tmp files orphaned by a kill between mkstemp and the
+    # rename (the ckpt_manifest_commit seam's litter): safe to sweep —
+    # the depth-1 queue means no other writer has a commit in flight
+    if exp_dir.is_dir():
+        for tmp in exp_dir.glob(f"ckpt_*{ZEROSTALL_SUFFIX}*.tmp"):
+            tmp.unlink(missing_ok=True)
+    if not root.is_dir():
+        return 0, 0
+    refs = referenced_digests(exp_dir)
+    removed = 0
+    removed_bytes = 0
+    kept = 0
+    for sub in sorted(root.iterdir()):
+        if not sub.is_dir():
+            continue
+        for chunk in sorted(sub.iterdir()):
+            if chunk.name in refs:
+                kept += 1
+                continue
+            try:
+                removed_bytes += chunk.stat().st_size
+                chunk.unlink()
+                removed += 1
+            except OSError:
+                kept += 1  # racing writer re-publishing it; leave it
+        try:
+            sub.rmdir()  # only succeeds when empty
+        except OSError:
+            pass
+    if removed:
+        telemetry.emit(
+            "ckpt_gc", engine="zerostall", removed=removed,
+            removed_bytes=removed_bytes, kept=kept,
+            seconds=round(time.monotonic() - t0, 4),
+        )
+    return removed, removed_bytes

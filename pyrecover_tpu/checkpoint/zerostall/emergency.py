@@ -1,0 +1,235 @@
+"""In-RAM emergency checkpoint tier: restore without touching disk.
+
+Disk restores scale with checkpoint size; a fleet that restarts often
+pays that tax on every churn event. This tier keeps the latest COMMITTED
+zerostall snapshot in host RAM so ``train._resume`` can restore in
+milliseconds when the disk tier is behind (a save was mid-write when the
+process died and its manifest never published) or gone entirely.
+
+Semantics:
+
+  * **Publish** happens from the zerostall writer thread AFTER the
+    manifest commit — the tier only ever holds states that were durable
+    at least once, so preferring it can never resurrect an uncommitted
+    step.
+  * **Single host degenerates to a local shadow copy**: the writer
+    already holds the host-side numpy leaves; publishing is a pointer
+    hand-off, not a copy. Costs one state-sized slab of host RAM
+    (disable with ``$PYRECOVER_EMERGENCY=0``).
+  * **Multi-host**: host 0 (the writer) always holds the shadow copy.
+    With ``$PYRECOVER_EMERGENCY_PEER=1`` every host additionally joins a
+    process-group exchange (``multihost_utils.process_allgather`` over
+    the committed leaves, pinned to the CALLING thread like every other
+    collective — it runs inside the next save's blocking window, not the
+    shadow) so each host's RAM holds the full state and a restart can
+    restore from a *peer's* RAM even when the local disk is cold. The
+    exchange rides the ICI allgather because JAX exposes no host-to-host
+    point-to-point primitive; it is opt-in precisely because it moves
+    state-sized bytes.
+  * **Strict freshness/digest gate before the tier is ever preferred**:
+    the record's step must be at least the newest disk manifest's, the
+    saved topology must match the live mesh exactly (elastic restores
+    belong to the disk path), and every leaf's chunk digests are
+    RECOMPUTED over the in-RAM bytes and compared against the manifest
+    — a bit-flipped or torn RAM record is rejected, never restored.
+
+The store is process-local by construction (RAM dies with the process);
+it exists across ``train()`` calls in one process — the resilient-
+launcher / notebook / test scenario — and for peers, in their processes.
+"""
+
+import os
+import threading
+import time
+from pathlib import Path
+
+import jax
+import numpy as np
+
+from pyrecover_tpu import telemetry
+from pyrecover_tpu.checkpoint.zerostall import chunkstore
+from pyrecover_tpu.utils.logging import log_host0
+
+EMERGENCY_ENV = "PYRECOVER_EMERGENCY"
+PEER_EXCHANGE_ENV = "PYRECOVER_EMERGENCY_PEER"
+
+_store = {}
+_lock = threading.Lock()
+
+
+def enabled():  # jaxlint: host-only
+    return os.environ.get(EMERGENCY_ENV, "1") != "0"
+
+
+def _key(exp_dir):
+    return str(Path(exp_dir).absolute())
+
+
+def publish(exp_dir, doc, np_leaves):  # jaxlint: host-only
+    """Install a just-committed snapshot as the experiment's emergency
+    record (writer thread, host 0). Pointer hand-off — the caller must
+    not mutate ``np_leaves`` afterwards."""
+    if not enabled():
+        return None
+    record = {
+        "doc": doc,
+        "leaves": np_leaves,
+        "step": int(doc.get("step", 0)),
+        "published_ts": time.time(),
+        "peer_replicated": False,
+    }
+    with _lock:
+        _store[_key(exp_dir)] = record
+    telemetry.emit(
+        "emergency_publish", engine="zerostall", step=record["step"],
+        exp_dir=str(exp_dir), leaves=len(np_leaves),
+        bytes=int(sum(a.nbytes for a in np_leaves)),
+    )
+    return record
+
+
+def replicate_to_peers(exp_dir):  # jaxlint: host-only sync-point
+    """Opt-in process-group exchange (``$PYRECOVER_EMERGENCY_PEER=1``):
+    allgather the latest published record's leaves so EVERY host's RAM
+    holds the full state. Collective — must run on the main thread (the
+    zerostall engine calls it inside the next save's blocking window).
+    No-op on a single host (the local shadow copy already is the tier)."""
+    if jax.process_count() <= 1:
+        return False
+    if os.environ.get(PEER_EXCHANGE_ENV) != "1":
+        return False
+    with _lock:
+        record = _store.get(_key(exp_dir))
+    if record is None or record.get("peer_replicated"):
+        return False
+    from jax.experimental import multihost_utils
+
+    # host 0 holds the authoritative copy; the broadcast lands it in
+    # every process's RAM (tiled allgather over each leaf)
+    leaves = record["leaves"]
+    replicated = [
+        np.asarray(multihost_utils.broadcast_one_to_all(a)) for a in leaves
+    ]
+    with _lock:
+        record["leaves"] = replicated
+        record["peer_replicated"] = True
+    return True
+
+
+def peek(exp_dir):
+    """(step, record) of the experiment's emergency record, else None."""
+    with _lock:
+        record = _store.get(_key(exp_dir))
+    if record is None:
+        return None
+    return record["step"], record
+
+
+def usable(exp_dir, target_topology, *, min_step=0):
+    """Host-local gate: is there a record fresh enough and on the SAME
+    topology? (Elastic cross-topology restores go through the disk path,
+    which has the preflight machinery.) Returns the record or None."""
+    from pyrecover_tpu.checkpoint.elastic import topologies_differ
+
+    got = peek(exp_dir)
+    if got is None:
+        return None
+    step, record = got
+    if step < min_step:
+        return None
+    if topologies_differ(record["doc"].get("topology"), target_topology):
+        return None
+    if jax.process_count() > 1 and not record.get("peer_replicated"):
+        # without peer replication only host 0 holds the bytes; a pod
+        # restore needs them everywhere — fall back to disk
+        return None
+    return record
+
+
+def verify(record):  # jaxlint: host-only
+    """Strict digest check: recompute every leaf's chunk digests over the
+    in-RAM bytes and compare against the committed manifest. Returns
+    ``(ok, reason)`` — the gate ``train._resume`` runs on host 0 before
+    the tier is ever preferred over disk."""
+    doc, np_leaves = record["doc"], record["leaves"]
+    if len(np_leaves) != len(doc.get("leaves", [])):
+        return False, (
+            f"record holds {len(np_leaves)} leaves, manifest lists "
+            f"{len(doc.get('leaves', []))}"
+        )
+    for entry, arr in zip(doc["leaves"], np_leaves):
+        digests = chunkstore.leaf_chunk_digests(
+            arr, int(entry["chunk_bytes"])
+        )
+        if digests != entry["chunks"]:
+            return False, (
+                f"{entry['path']}: in-RAM bytes no longer match the "
+                "committed manifest digests"
+            )
+    return True, ""
+
+
+def restore(exp_dir, target_state):  # jaxlint: host-only
+    """Restore ``target_state`` from the in-RAM record, verifying every
+    leaf's chunk digests against the manifest first (strict: a digest
+    mismatch raises and the caller falls back to disk). Returns
+    ``(state, sampler_state, doc)``."""
+    got = peek(exp_dir)
+    if got is None:
+        raise LookupError(f"no emergency record for {exp_dir}")
+    _, record = got
+    doc, np_leaves = record["doc"], record["leaves"]
+    t0 = time.monotonic()
+    leaves, treedef = jax.tree_util.tree_flatten(target_state)
+    if len(np_leaves) != len(leaves):
+        raise ValueError(
+            f"emergency record has {len(np_leaves)} leaves, target "
+            f"expects {len(leaves)}"
+        )
+    with telemetry.span(
+        "ckpt_emergency_verify", engine="zerostall",
+        metric="ckpt_zerostall_emergency_verify_s",
+    ):
+        ok, reason = verify(record)
+        if not ok:
+            raise ValueError(f"emergency record rejected: {reason}")
+    with telemetry.span(
+        "ckpt_emergency_restore", engine="zerostall",
+        metric="ckpt_zerostall_emergency_restore_s",
+    ):
+        restored = []
+        for tgt, src in zip(leaves, np_leaves):
+            if tuple(tgt.shape) != tuple(src.shape):
+                raise ValueError(
+                    f"emergency record shape {src.shape} vs target "
+                    f"{tgt.shape}"
+                )
+            src = np.asarray(src).astype(tgt.dtype)
+            if isinstance(tgt, jax.Array) and hasattr(tgt, "sharding"):
+                restored.append(jax.device_put(src, tgt.sharding))
+            else:
+                restored.append(jax.numpy.asarray(src))
+        state = jax.tree_util.tree_unflatten(treedef, restored)
+    # jaxlint: disable-next=untimed-device-work -- the milliseconds
+    # claimed here are digest verification + device_put enqueue; the
+    # first post-restore train step syncs the transfers
+    seconds = time.monotonic() - t0
+    log_host0(
+        "Restored step %d from the in-RAM emergency tier in %.3fs "
+        "(disk tier bypassed)", int(doc.get("step", 0)), seconds,
+    )
+    telemetry.emit(
+        "emergency_restore", engine="zerostall",
+        step=int(doc.get("step", 0)), seconds=round(seconds, 4),
+    )
+    return state, doc.get("sampler", {}), doc
+
+
+def drop(exp_dir=None):
+    """Forget records (all of them with no argument) — test hygiene and
+    the explicit opt-out for memory-tight callers."""
+    with _lock:
+        if exp_dir is None:
+            _store.clear()
+        else:
+            _store.pop(_key(exp_dir), None)

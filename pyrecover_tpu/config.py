@@ -82,7 +82,13 @@ class TrainConfig:
     verify_checkpoints: bool = False
     max_kept_checkpoints: int = 3
     sharded_checkpoint: bool = False  # --use-torch-distributed-ckpt equivalent
-    async_checkpoint: bool = True  # overlap sharded saves with training
+    # which engine writes checkpoints: "vanilla" (single-file streaming),
+    # "sharded" (Orbax/tensorstore), or "zerostall" (async snapshot
+    # pipeline + content-addressed chunk store + in-RAM emergency tier,
+    # checkpoint/zerostall/). "" derives from --sharded-checkpoint; an
+    # explicit value wins over the legacy boolean.
+    checkpoint_engine: str = ""  # "" | vanilla | sharded | zerostall
+    async_checkpoint: bool = True  # overlap saves with training
     # topology-elastic resume (checkpoint/elastic.py): "auto" reshards a
     # checkpoint saved on a different topology onto the live mesh (after a
     # mandatory shardcheck preflight), "on" always runs the elastic gate,
@@ -130,6 +136,18 @@ class TrainConfig:
     profile_dir: str = "profiles/"
 
     def __post_init__(self):
+        # engine resolution: the explicit --checkpoint-engine wins; the
+        # legacy --sharded-checkpoint boolean is kept in sync because the
+        # sharded-specific machinery (Orbax checkpointer) keys off it
+        if not self.checkpoint_engine:
+            self.checkpoint_engine = (
+                "sharded" if self.sharded_checkpoint else "vanilla"
+            )
+        elif self.checkpoint_engine not in ("vanilla", "sharded", "zerostall"):
+            raise ValueError(
+                f"unknown checkpoint engine {self.checkpoint_engine!r}"
+            )
+        self.sharded_checkpoint = self.checkpoint_engine == "sharded"
         if self.attention_impl == "auto":
             if self.mesh.sequence > 1:
                 attn = "ring"
@@ -287,6 +305,17 @@ def build_parser():
     p.add_argument("--use-torch-distributed-ckpt", "--sharded-checkpoint",
                    dest="sharded_checkpoint", action="store_true",
                    help="Sharded multi-host checkpoint (Orbax/tensorstore).")
+    # default None (not d.checkpoint_engine: post_init already resolved
+    # that to a concrete engine, which would silently outvote the legacy
+    # --sharded-checkpoint flag); unset defers to the boolean
+    p.add_argument("--checkpoint-engine", type=str, default=None,
+                   choices=["vanilla", "sharded", "zerostall"],
+                   help="Checkpoint engine: vanilla single-file, sharded "
+                        "(Orbax), or zerostall (async snapshot pipeline + "
+                        "content-addressed chunk dedup + in-RAM emergency "
+                        "restore tier; the save window is invisible to the "
+                        "train loop). Default: sharded when "
+                        "--sharded-checkpoint is set, else vanilla.")
     p.add_argument("--no-async-checkpoint", action="store_true")
     p.add_argument("--elastic-resume", type=str, default=d.elastic_resume,
                    choices=["auto", "on", "off"],
@@ -405,6 +434,7 @@ def get_args(argv=None):
         verify_checkpoints=ns.verify_checkpoints,
         max_kept_checkpoints=ns.max_kept_checkpoints,
         sharded_checkpoint=ns.sharded_checkpoint,
+        checkpoint_engine=ns.checkpoint_engine or "",
         async_checkpoint=not ns.no_async_checkpoint,
         elastic_resume=ns.elastic_resume,
         timeaware_checkpointing=ns.timeaware_checkpointing,

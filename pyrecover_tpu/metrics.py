@@ -154,6 +154,10 @@ class WallTimeTotals:
       * ``step_s`` — time actually spent stepping (interval sums between
         sync points, checkpoint and eval excluded).
       * ``ckpt_save_s`` / ``ckpt_load_s`` — blocking checkpoint seconds.
+        ``ckpt_blocking_s`` is the same train-loop-stall charge under its
+        honest name; ``ckpt_shadow_s`` counts the OVERLAPPED background
+        save work (async vanilla writes, the zerostall pipeline) —
+        recovered goodput, visible but never charged to ``lost_s``.
       * ``eval_s`` — held-out evaluation wall time.
       * ``setup_s`` — pre-loop warmup (mesh/model init, compile staging);
         on a restarted run this is part of the restart tax.
@@ -169,6 +173,8 @@ class WallTimeTotals:
         self.train_s = 0.0
         self.step_s = 0.0
         self.ckpt_save_s = 0.0
+        self.ckpt_blocking_s = 0.0
+        self.ckpt_shadow_s = 0.0
         self.ckpt_load_s = 0.0
         self.eval_s = 0.0
         self.setup_s = 0.0
@@ -180,7 +186,10 @@ class WallTimeTotals:
         return max(self.step_s - self.replayed_s, 0.0)
 
     def lost_s(self):
-        """Resilience overhead: time that bought durability, not progress."""
+        """Resilience overhead: time that bought durability, not progress.
+        Only the BLOCKING checkpoint seconds count — shadow (overlapped)
+        save work ran while training stepped, so charging it would hide
+        exactly the goodput an async engine recovers."""
         return (
             self.ckpt_save_s + self.ckpt_load_s + self.replayed_s + self.setup_s
         )
@@ -196,6 +205,8 @@ class WallTimeTotals:
             "train_s": round(self.train_s, 3),
             "step_s": round(self.step_s, 3),
             "ckpt_save_s": round(self.ckpt_save_s, 3),
+            "ckpt_blocking_s": round(self.ckpt_blocking_s, 3),
+            "ckpt_shadow_s": round(self.ckpt_shadow_s, 3),
             "ckpt_load_s": round(self.ckpt_load_s, 3),
             "eval_s": round(self.eval_s, 3),
             "setup_s": round(self.setup_s, 3),
@@ -213,6 +224,8 @@ class WallTimeTotals:
             f"ckpt save {self.ckpt_save_s:.1f}s | ckpt load {self.ckpt_load_s:.1f}s | "
             f"eval {self.eval_s:.1f}s"
         )
+        if self.ckpt_shadow_s:
+            s += f" | ckpt shadow {self.ckpt_shadow_s:.1f}s (overlapped)"
         if self.replayed_steps:
             s += (
                 f" | replayed {self.replayed_steps} steps"

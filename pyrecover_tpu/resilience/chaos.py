@@ -30,6 +30,14 @@ A smoke soak is four trainer runs over one experiment directory::
                and finish; gated on loss continuity vs the golden
                (bit-exact before the shrink, tolerance-aware after) and
                the elastic_resume/sampler_rescaled telemetry trail
+    cycles 10-15: zerostall drill in its own exp dirs — async-zerostall
+               golden + SIGTERM seed run, then SIGKILL at each pipeline
+               stage (device→host snapshot, chunk-store write, between
+               durable chunks and the manifest rename) and a recovery
+               run; gated on bit-exact stitched loss vs the zerostall
+               golden, every kill site fired, a torn save leaving the
+               previous manifest restorable (no quarantines), and zero
+               chunks leaked after GC
 
 Verdicts: per-cycle exit codes, stitched CSV == golden CSV, exactly the
 injected corruption quarantined (zero non-injected losses), and the
@@ -77,7 +85,7 @@ PRESETS = {
 
 
 def _trainer_cmd(preset, exp, seed, workdir, *, resume=False,
-                 extra_args=()):
+                 extra_args=(), sync_ckpt=True):
     cmd = [
         sys.executable, "-m", "pyrecover_tpu.train",
         "--training-steps", str(preset["training_steps"]),
@@ -96,9 +104,12 @@ def _trainer_cmd(preset, exp, seed, workdir, *, resume=False,
         "--timeaware-checkpointing",
         "--log-loss-to-csv", "--telemetry",
         "--verify-checkpoints",  # checksum sidecars make corruption visible
-        "--no-async-checkpoint",
         *_TINY_MODEL_ARGS,
     ]
+    if sync_ckpt:
+        # the classic drills save synchronously; the zerostall drill keeps
+        # async saves ON — the overlapped pipeline IS the thing under test
+        cmd += ["--no-async-checkpoint"]
     if resume:
         cmd += ["--resume-from-checkpoint", "latest"]
     cmd += list(extra_args)
@@ -242,9 +253,9 @@ def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
     cycles = []
 
     def cycle(name, *, fault_plan, resume, expect_rc, exp="chaos",
-              extra_args=(), device_count=None):
+              extra_args=(), device_count=None, sync_ckpt=True):
         cmd = _trainer_cmd(preset, exp, seed, workdir, resume=resume,
-                           extra_args=extra_args)
+                           extra_args=extra_args, sync_ckpt=sync_ckpt)
         try:
             rc, secs = _run_trainer(
                 cmd, fault_plan=fault_plan, log_path=log_path,
@@ -335,6 +346,35 @@ def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
           })
     cycle("elastic_regrow@4dev", resume=True, expect_rc=(0,), exp="elastic",
           device_count=4, fault_plan=None)
+
+    # cycles 10-15 — zerostall drill (own exp dirs): the async snapshot
+    # pipeline killed at EVERY stage. A golden async-zerostall run, a
+    # SIGTERM at s1 to seed a resumable manifest, then SIGKILL during the
+    # device→host snapshot, during a chunk-store write, and in the gap
+    # between durable chunks and the manifest rename — each torn save must
+    # leave the previous manifest as the newest restorable checkpoint —
+    # and a recovery run that finishes. Gated below on bit-exact stitched
+    # loss vs the zerostall golden, zero quarantines (a torn zerostall
+    # save never publishes anything to quarantine), and zero leaked
+    # chunks after the final GC.
+    zs_args = ("--checkpoint-engine", "zerostall")
+    cycle("zs_golden", resume=False, expect_rc=(0,), exp="zs_golden",
+          fault_plan=None, extra_args=zs_args, sync_ckpt=False)
+    cycle("zs_sigterm", resume=False, expect_rc=(0,), exp="zs",
+          extra_args=zs_args, sync_ckpt=False, fault_plan={
+              "seed": seed,
+              "faults": [{"type": "sigterm_at_step", "step": s1}],
+          })
+    for stage in ("ckpt_snapshot", "ckpt_chunk_write",
+                  "ckpt_manifest_commit"):
+        cycle(f"zs_kill@{stage}", resume=True, expect_rc=(-9, 137),
+              exp="zs", extra_args=zs_args, sync_ckpt=False, fault_plan={
+                  "seed": seed,
+                  "faults": [{"type": "kill9_during_save",
+                              "save_index": 1, "site": stage}],
+              })
+    cycle("zs_recover", resume=True, expect_rc=(0,), exp="zs",
+          extra_args=zs_args, sync_ckpt=False, fault_plan=None)
 
     exp_dir = workdir / "chaos"
     golden_rows = _read_csv_rows(
@@ -464,6 +504,93 @@ def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
             f"{e_doctor['classification']!r}, expected 'healthy'"
         )
 
+    # zerostall drill verdicts: stitched-vs-golden bit-exactness, DONE
+    # marker, the kill trail at every pipeline stage, no quarantines (a
+    # torn zerostall save publishes nothing), and ZERO chunk leakage —
+    # after the recovery run's GC the chunk store holds exactly the
+    # chunks the live manifests reference
+    from pyrecover_tpu.checkpoint.zerostall import chunkstore as zs_chunks
+
+    zs_dir = workdir / "zs"
+    zs_golden_rows = _read_csv_rows(
+        workdir / "zs_golden" / "zs_golden_loss_log.csv"
+    )
+    zs_rows = _read_csv_rows(zs_dir / "zs_loss_log.csv")
+    zs_divergence = None
+    for i, (a, b) in enumerate(zip(zs_golden_rows, zs_rows)):
+        if a != b:
+            zs_divergence = {"row": i, "golden": a, "stitched": b}
+            break
+    zs_continuity = (
+        zs_divergence is None
+        and len(zs_rows) == len(zs_golden_rows) == steps + 1
+    )
+    if not zs_continuity:
+        violations.append(
+            "zerostall drill: loss continuity broken: "
+            + (json.dumps(zs_divergence) if zs_divergence else
+               f"{len(zs_rows)} stitched rows vs {len(zs_golden_rows)} "
+               f"golden (want {steps + 1})")
+        )
+    if not (zs_dir / "DONE").exists():
+        violations.append(
+            "zerostall drill: no DONE marker after the recovery cycle"
+        )
+    zs_quarantined = [p.name for p in list_quarantined(zs_dir)]
+    if zs_quarantined:
+        violations.append(
+            "zerostall drill: a torn save must publish nothing, but "
+            f"{zs_quarantined} got quarantined"
+        )
+    zs_events = read_events(zs_dir / "zs_telemetry.jsonl")
+    zs_kill_sites = {
+        e.get("site") for e in zs_events
+        if e["event"] == "fault_injected"
+        and e.get("type") == "kill9_during_save"
+    }
+    for stage in ("ckpt_snapshot", "ckpt_chunk_write",
+                  "ckpt_manifest_commit"):
+        if stage not in zs_kill_sites:
+            violations.append(
+                f"zerostall drill: no kill9_during_save fired at {stage}"
+            )
+    zs_resumes = [e for e in zs_events if e["event"] == "resume"]
+    if len(zs_resumes) < 4:
+        violations.append(
+            f"zerostall drill: expected >=4 resume events (one per kill "
+            f"cycle + recovery), got {len(zs_resumes)}"
+        )
+    referenced = zs_chunks.referenced_digests(zs_dir)
+    on_disk = {
+        p.name for p in zs_chunks.chunks_root(zs_dir).rglob("*")
+        if p.is_file()
+    }
+    leaked = sorted(on_disk - referenced)
+    missing = sorted(referenced - on_disk)
+    if leaked:
+        violations.append(
+            f"zerostall drill: {len(leaked)} chunk(s) leaked past GC "
+            f"(e.g. {leaked[:3]})"
+        )
+    if missing:
+        violations.append(
+            f"zerostall drill: {len(missing)} referenced chunk(s) missing "
+            f"from the store (e.g. {missing[:3]}) — live manifests are "
+            "not restorable"
+        )
+    zs_info = {
+        "rows": len(zs_rows),
+        "continuity_ok": zs_continuity,
+        "kill_sites": sorted(s for s in zs_kill_sites if s),
+        "resumes": len(zs_resumes),
+        "chunks_on_disk": len(on_disk),
+        "chunks_referenced": len(referenced),
+        "chunks_leaked": len(leaked),
+        "backpressure_events": sum(
+            1 for e in zs_events if e["event"] == "ckpt_backpressure"
+        ),
+    }
+
     report = {
         "preset": preset_name,
         "seed": seed,
@@ -487,6 +614,7 @@ def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
             "doctor_phase": hang_doctor.get("phase"),
         },
         "elastic": elastic_info,
+        "zerostall": zs_info,
         "telemetry_rotated_shards": rotated,
         "telemetry_counts": {
             k: counts.get(k, 0)
@@ -536,6 +664,12 @@ def main(argv=None):
           f"{el.get('bitexact_rows')} bit-exact rows, max rel diff "
           f"{el.get('max_rel_diff')} (tol {el.get('rtol')}) | doctor "
           f"{el.get('doctor_classification')}")
+    zs = report.get("zerostall") or {}
+    print(f"  zerostall: kills at {zs.get('kill_sites')} | "
+          f"{zs.get('resumes')} resumes | chunks "
+          f"{zs.get('chunks_on_disk')} on disk = "
+          f"{zs.get('chunks_referenced')} referenced "
+          f"({zs.get('chunks_leaked')} leaked)")
     if report["violations"]:
         for v in report["violations"]:
             print(f"  VIOLATION: {v}")

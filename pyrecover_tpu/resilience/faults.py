@@ -21,12 +21,15 @@ Plan format (JSON — inline in ``$PYRECOVER_FAULT_PLAN`` or a file path)::
 Injection sites (``check(site, **ctx)`` seams placed in production code):
 
     train_step        train.py hot loop   ctx: step (the step about to run)
-    ckpt_save_begin   both engines' save  ctx: engine, path (bumps save index)
+    ckpt_save_begin   every engine's save ctx: engine, path (bumps save index)
     ckpt_write        vanilla stream / native_io write   ctx: path, written
     ckpt_fsync        vanilla stream pre-publish         ctx: path
     ckpt_rename       vanilla atomic publish             ctx: path
     ckpt_commit       after a save is durable            ctx: engine, path
-    ckpt_read         vanilla/native read path           ctx: path
+    ckpt_read         vanilla/native/chunk read path     ctx: path
+    ckpt_snapshot     zerostall device→host snapshot     ctx: path, leaves
+    ckpt_chunk_write  zerostall chunk store write        ctx: path, written
+    ckpt_manifest_commit  zerostall durable-but-unpublished manifest  ctx: path
     loader_batch      data loader batch materialization  ctx: batch
     metadata_poll     maintenance watcher poll loop      ctx: base
 
@@ -116,19 +119,32 @@ class _SigtermAtStep(_Fault):
 class _Kill9DuringSave(_Fault):
     """SIGKILL mid-checkpoint-write: the save that must never corrupt
     ``latest``. ``save_index`` picks which save of the run (1-based),
-    ``after_bytes`` how deep into the stream the kill lands."""
+    ``after_bytes`` how deep into the stream the kill lands. ``site``
+    optionally pins WHICH stage dies — the vanilla stream write
+    (``ckpt_write``, the default-compatible site) or any zerostall
+    pipeline stage (``ckpt_snapshot`` mid device→host copy,
+    ``ckpt_chunk_write`` mid chunk store write, ``ckpt_manifest_commit``
+    between the durable chunks and the manifest rename)."""
 
-    sites = ("ckpt_write",)
+    sites = ("ckpt_write", "ckpt_snapshot", "ckpt_chunk_write",
+             "ckpt_manifest_commit")
     type_name = "kill9_during_save"
 
     def __init__(self, spec):
         super().__init__(spec)
         self.save_index = int(spec.get("save_index", 1))
         self.after_bytes = int(spec.get("after_bytes", 0))
+        self.site = spec.get("site")
+        if self.site is not None and self.site not in self.sites:
+            raise FaultPlanError(
+                f"kill9_during_save: unknown site {self.site!r}; "
+                f"known: {list(self.sites)}"
+            )
 
     def should_fire(self, engine, site, ctx):
         return (
             not self.fired
+            and (self.site is None or site == self.site)
             and engine.save_index == self.save_index
             and ctx.get("written", 0) >= self.after_bytes
         )
@@ -186,10 +202,13 @@ class _TransientIOError(_Fault):
     """EIO on checkpoint write/fsync/rename/read that heals after
     ``fail_count`` raises — the retry/backoff path's proof load."""
 
-    sites = ("ckpt_write", "ckpt_fsync", "ckpt_rename", "ckpt_read")
+    sites = ("ckpt_write", "ckpt_fsync", "ckpt_rename", "ckpt_read",
+             "ckpt_chunk_write", "ckpt_manifest_commit")
     type_name = "transient_io_error"
     _OPS = {"write": "ckpt_write", "fsync": "ckpt_fsync",
-            "rename": "ckpt_rename", "read": "ckpt_read", "any": None}
+            "rename": "ckpt_rename", "read": "ckpt_read",
+            "chunk_write": "ckpt_chunk_write",
+            "manifest_commit": "ckpt_manifest_commit", "any": None}
 
     def __init__(self, spec):
         super().__init__(spec)

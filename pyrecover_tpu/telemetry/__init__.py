@@ -19,8 +19,25 @@ Core event names across the stack (fields beyond the envelope):
     eval              step, loss, seconds
     ckpt_save_start   engine, path, background/async_
     ckpt_commit       engine, path, bytes, write_s, checksum
+                      (zerostall adds reused_bytes, chunks_written,
+                      chunks_reused — the chunk-dedup ledger)
     ckpt_save_blocking engine, path, step, blocking_s, final
+    ckpt_save_shadow  engine, path, shadow_s, ok (background save work
+                      that OVERLAPPED training — recovered goodput, split
+                      from the blocking stall in WallTimeTotals)
     ckpt_save_durable engine, wait_s
+    ckpt_backpressure engine, path, wait_s (a save arrived while the
+                      previous zerostall save was still in flight; the
+                      depth-1 queue made it wait, loudly)
+    ckpt_gc           engine, removed, removed_bytes, kept, seconds
+                      (refcounted chunk GC collected orphans; a chunk any
+                      live manifest references is never collected)
+    emergency_publish engine, step, exp_dir, leaves, bytes (a committed
+                      zerostall snapshot entered the in-RAM tier)
+    emergency_restore engine, step, seconds (_resume restored from RAM,
+                      disk tier bypassed)
+    emergency_restore_rejected  reason[, step] (the strict freshness/
+                      digest gate refused the RAM record; disk wins)
     ckpt_restore_start/ckpt_restore_done  engine, path, seconds
     ckpt_precheck_failed / ckpt_restore_fallback  path, reason
     ckpt_io_retry     op, path, attempt, errno, delay_s (transient-IO retry)

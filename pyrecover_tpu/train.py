@@ -26,7 +26,9 @@ from pyrecover_tpu.checkpoint import (
     checkpoint_path,
     list_checkpoints,
     load_ckpt_vanilla,
+    load_ckpt_zerostall,
     save_ckpt_vanilla,
+    save_ckpt_zerostall,
 )
 from pyrecover_tpu.config import TrainConfig, get_args
 from pyrecover_tpu.data import DataLoader, StatefulSampler, SyntheticTextDataset
@@ -251,24 +253,98 @@ def _resume(config, exp_dir, state, sampler, sharded_ckptr, totals):  # jaxlint:
     quarantining, the checkpoint is intact, it just doesn't fit this
     mesh. With ``--elastic-resume off`` a topology drift raises a typed
     ``TopologyMismatchError`` naming both topologies.
+
+    Zerostall engine only: the in-RAM emergency tier
+    (``checkpoint/zerostall/emergency.py``) is consulted FIRST on a
+    "latest" resume. When host 0 holds a committed snapshot that is at
+    least as fresh as the newest disk manifest, on the SAME topology,
+    and its recomputed chunk digests match the committed manifest, the
+    restore happens from RAM in milliseconds — the disk tier (possibly
+    behind, mid-write, or gone) is never touched. Any gate failure
+    falls through to the normal disk walk silently; a record that
+    passes the gate but fails mid-restore falls back loudly
+    (``emergency_restore_rejected``).
     """
     from pyrecover_tpu.checkpoint import elastic, precheck_ckpt_sharded
     from pyrecover_tpu.checkpoint.elastic import TopologyMismatchError
+    from pyrecover_tpu.checkpoint.registry import parse_step
     from pyrecover_tpu.checkpoint.vanilla import (
         CheckpointStructureError,
         precheck_ckpt_vanilla,
     )
-    from pyrecover_tpu.parallel.mesh import broadcast_host0_scalar
+    from pyrecover_tpu.checkpoint.zerostall import (
+        emergency,
+        precheck_ckpt_zerostall,
+    )
+    from pyrecover_tpu.parallel.mesh import (
+        broadcast_host0_scalar,
+        state_topology,
+    )
 
     t0 = time.monotonic()
+    engine = config.checkpoint_engine
     target = config.resume_from_checkpoint
     explicit = target != "latest"
     if explicit:
         candidates = [target]
     else:
-        candidates = list_checkpoints(
-            exp_dir, sharded=config.sharded_checkpoint
-        )[::-1]
+        candidates = list_checkpoints(exp_dir, engine=engine)[::-1]
+        if not candidates and not (
+            engine == "zerostall" and emergency.peek(exp_dir) is not None
+        ):
+            log_host0("No checkpoint found in %s; starting fresh", exp_dir)
+            return 0, state
+
+    # ---- in-RAM emergency tier (zerostall, "latest" only) ------------------
+    # host-0 gate: fresh enough (>= newest disk manifest), same topology,
+    # digests intact; verdict broadcast so every host takes the same path
+    if engine == "zerostall" and not explicit:
+        use_ram = 0
+        if jax.process_index() == 0:
+            best_disk = parse_step(candidates[0]) if candidates else -1
+            record = emergency.usable(
+                exp_dir, state_topology(state), min_step=max(best_disk, 0)
+            )
+            if record is not None:
+                ok, reason = emergency.verify(record)
+                if ok:
+                    use_ram = 1
+                else:
+                    telemetry.emit(
+                        "emergency_restore_rejected", reason=reason,
+                        step=record["step"],
+                    )
+                    log_host0(
+                        "in-RAM emergency record rejected (%s); using the "
+                        "disk tier", reason, level=30,  # WARNING
+                    )
+        if int(broadcast_host0_scalar(use_ram)) == 1:
+            try:
+                state, sampler_meta, doc = emergency.restore(exp_dir, state)
+            except Exception as e:
+                # verified on host 0 a moment ago — reaching here means a
+                # race/rot between gate and restore; disk is the truth
+                telemetry.emit(
+                    "emergency_restore_rejected",
+                    reason=f"{type(e).__name__}: {e}",
+                )
+                log_host0(
+                    "emergency-tier restore failed (%s: %s); falling back "
+                    "to the disk tier", type(e).__name__, e, level=30,
+                )
+            else:
+                start_step = int(doc.get("step", 0))
+                sampler.seek(sampler_meta.get("consumed", start_step))
+                totals.ckpt_load_s += time.monotonic() - t0
+                log_host0(
+                    "Resumed from the in-RAM emergency tier at step %d "
+                    "(%.3f s)", start_step, totals.ckpt_load_s,
+                )
+                telemetry.emit(
+                    "resume", path="<emergency-ram>", step=start_step,
+                    seconds=round(totals.ckpt_load_s, 4),
+                )
+                return start_step, state
         if not candidates:
             log_host0("No checkpoint found in %s; starting fresh", exp_dir)
             return 0, state
@@ -296,8 +372,16 @@ def _resume(config, exp_dir, state, sampler, sharded_ckptr, totals):  # jaxlint:
                     elastic.GATE_MISMATCH: 4,
                 }[gate]
                 if verdict in (1, 5) and not explicit:
-                    if config.sharded_checkpoint:
+                    if engine == "sharded":
                         ok, why = precheck_ckpt_sharded(cand, state)
+                    elif engine == "zerostall":
+                        # manifest + per-chunk existence/size (digest
+                        # rehash with --verify-checkpoints); the schema
+                        # diff dies on a wrong-model resume here
+                        ok, why = precheck_ckpt_zerostall(
+                            cand, verify=config.verify_checkpoints,
+                            target_state=state,
+                        )
                     else:
                         # target_state activates the manifest schema diff:
                         # a wrong-model resume dies on a header read here,
@@ -371,12 +455,19 @@ def _resume(config, exp_dir, state, sampler, sharded_ckptr, totals):  # jaxlint:
         )
         try:
             with reshard_span:
-                if config.sharded_checkpoint:
+                if engine == "sharded":
                     # per-leaf reads with the TARGET shardings (not the
                     # saved ones): Orbax range-reads each leaf straight
                     # into its target shards — the sharded engine's
                     # reshard execution
                     state, sampler_meta, meta = sharded_ckptr.restore(
+                        cand, state
+                    )
+                elif engine == "zerostall":
+                    # chunk reads re-verify their content digests; leaves
+                    # assemble host-side and device_put onto the TARGET
+                    # shardings (elastic execution identical to vanilla)
+                    state, sampler_meta, meta = load_ckpt_zerostall(
                         cand, state
                     )
                 else:
@@ -627,16 +718,21 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
     )
 
     # ---- checkpoint strategy dispatch (reference train.py:153-161) ---------
-    pending_vanilla = []  # at most one in-flight background vanilla save
+    engine = config.checkpoint_engine
+    pending_saves = []  # at most one in-flight background save handle
 
     def join_pending_saves():
-        while pending_vanilla:
-            pending_vanilla.pop().wait()
+        while pending_saves:
+            handle = pending_saves.pop()
+            handle.wait()
+            # background seconds the train loop did NOT pay for: the
+            # goodput ledger's recovered-overlap bucket
+            totals.ckpt_shadow_s += getattr(handle, "shadow_s", 0.0) or 0.0
 
     def save_ckpt(step, final=False):
         path = checkpoint_path(
             config.checkpoint_dir, config.experiment_name, step,
-            final=final, sharded=config.sharded_checkpoint,
+            final=final, engine=engine,
         )
         # mesh-replicated GLOBAL scalar, like every other state leaf: a
         # bare jnp.asarray would be host-local, which the multi-host
@@ -665,17 +761,35 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
         if watcher is not None:
             watcher.arm_escalation(exp_dir, step)
         save_span = telemetry.spans.begin(
-            "ckpt_save", step=int(step), final=bool(final),
-            engine="sharded" if config.sharded_checkpoint else "vanilla",
+            "ckpt_save", step=int(step), final=bool(final), engine=engine,
         )
         try:
-            if config.sharded_checkpoint:
+            if engine == "sharded":
                 secs = sharded_ckptr.save(
                     path, state_to_save, sampler_meta,
                     max_keep=config.max_kept_checkpoints, extra_meta=extra,
                 )
                 if final:
                     sharded_ckptr.wait()
+            elif engine == "zerostall":
+                # the engine's own depth-1 queue back-pressures too, but
+                # joining here keeps handle shadow accounting in order
+                join_pending_saves()
+                if config.async_checkpoint and not final:
+                    secs, handle = save_ckpt_zerostall(
+                        path, state_to_save, sampler_meta,
+                        verify=config.verify_checkpoints,
+                        max_keep=config.max_kept_checkpoints,
+                        extra_meta=extra, background=True,
+                    )
+                    pending_saves.append(handle)
+                else:
+                    secs = save_ckpt_zerostall(
+                        path, state_to_save, sampler_meta,
+                        verify=config.verify_checkpoints,
+                        max_keep=config.max_kept_checkpoints,
+                        extra_meta=extra, background=False,
+                    )
             else:
                 join_pending_saves()  # serialize with any in-flight write
                 if config.async_checkpoint and not final:
@@ -685,7 +799,7 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
                         max_keep=config.max_kept_checkpoints,
                         extra_meta=extra, background=True,
                     )
-                    pending_vanilla.append(handle)
+                    pending_saves.append(handle)
                 else:
                     secs = save_ckpt_vanilla(
                         path, state_to_save, sampler_meta,
@@ -700,11 +814,15 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
             if watcher is not None:
                 watcher.disarm_escalation()
         save_span.end()
+        # the train-loop stall this save cost, under its honest name: the
+        # histogram feeds metrics_snapshot percentiles (and bench), the
+        # totals split blocking (lost) from shadow (overlapped) work
+        totals.ckpt_blocking_s += secs
+        telemetry.metrics.histogram("ckpt_blocking_s").observe(secs)
         log_host0("Saved checkpoint %s in %.2f s", path.name, secs)
         telemetry.emit(
             "ckpt_saved", step=int(step), path=path.name, final=bool(final),
-            engine="sharded" if config.sharded_checkpoint else "vanilla",
-            blocking_s=round(secs, 4),
+            engine=engine, blocking_s=round(secs, 4),
         )
         return secs
 

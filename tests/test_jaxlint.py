@@ -412,6 +412,64 @@ def test_span_metrics_apis_are_host_only_pruned():
     ), "span/metrics APIs must be host-only-pruned from the hot set"
 
 
+def test_zerostall_snapshot_apis_are_host_only_pruned():
+    """The zerostall engine's save/load/writer APIs carry `# jaxlint:
+    host-only` markers: their internal loops materialize host arrays
+    (np.asarray over every leaf, chunk assembly) and would light up JX01
+    through train.py's save path otherwise. Pinned against the real
+    package sources so a dropped marker fails here, not as a mystery
+    lint regression."""
+    from pyrecover_tpu.analysis.callgraph import ProjectIndex, build_hot_set
+    from pyrecover_tpu.analysis.engine import DEFAULT_CONFIG
+
+    pkg = REPO / "pyrecover_tpu"
+    modules = []
+    for rel in ("train.py", "checkpoint/zerostall/snapshot.py",
+                "checkpoint/zerostall/chunkstore.py",
+                "checkpoint/zerostall/emergency.py"):
+        p = pkg / rel
+        modules.append(ModuleInfo(p, p.read_text(), relpath=p))
+    hot = build_hot_set(ProjectIndex(modules), DEFAULT_CONFIG)
+    hot_files = {str(fn.module.relpath) for fn in hot}
+    assert any(s.endswith("train.py") for s in hot_files)
+    assert not any(
+        s.endswith(("snapshot.py", "chunkstore.py", "emergency.py"))
+        for s in hot_files
+    ), "zerostall snapshot/chunkstore/emergency APIs must be host-only"
+
+
+def test_snapshot_shaped_helper_trips_jx01_without_marker():
+    """The regression the fixture pair guards: an UNMARKED snapshot
+    helper with a per-leaf np.asarray loop reachable from the train loop
+    must trip JX01 — and the host-only marker (how the real zerostall
+    engine declares its writer) is what silences it. A deleted marker
+    can't slip a hot-loop host sync in unnoticed."""
+    unmarked = """
+import numpy as np
+
+def snapshot_to_host(leaves):
+    out = []
+    for leaf in leaves:
+        out.append(np.asarray(leaf))
+    return out
+
+
+def _train_impl(loader, step_fn, state):
+    while True:
+        batch = next(loader)
+        state, metrics = step_fn(state, batch)
+        snapshot_to_host([state])
+"""
+    findings = names(lint_source(unmarked))
+    assert "host-sync-in-hot-loop" in findings
+
+    marked = unmarked.replace(
+        "def snapshot_to_host(leaves):",
+        "def snapshot_to_host(leaves):  # jaxlint: host-only",
+    )
+    assert "host-sync-in-hot-loop" not in names(lint_source(marked))
+
+
 def test_hot_reachability_crosses_modules():
     """_train_impl in one module calls a helper in another; a loop sync in
     the helper is attributed there."""

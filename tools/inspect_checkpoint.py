@@ -151,6 +151,55 @@ def _diagnose_corrupt_vanilla(path, err):
     return 1
 
 
+def inspect_zerostall(path, show_leaves, show_chunks):
+    """Manifest view of a zerostall checkpoint: step/sampler/topology,
+    the shared schema manifest rows, the chunk reuse ledger, and (with
+    --chunks) the per-leaf chunk digest map with dedup/presence state."""
+    from pyrecover_tpu.checkpoint.zerostall import chunkstore
+
+    path = Path(path)
+    try:
+        doc = chunkstore.read_manifest(path)
+    except Exception as e:
+        print(f"CORRUPT: manifest does not parse ({type(e).__name__}: {e})")
+        print("a torn zerostall save never publishes its manifest — this "
+              "file was damaged AFTER commit; the trainer's 'latest' "
+              "resume falls back past it automatically")
+        return 1
+    print("format: zerostall manifest + content-addressed chunks")
+    for k in ("step", "epoch"):
+        if k in doc:
+            print(f"{k}: {doc[k]}")
+    if doc.get("sampler"):
+        print(f"sampler state: {doc['sampler']}")
+    _print_manifest_rows(doc["manifest"], show_leaves)
+    reuse = doc.get("reuse") or {}
+    if reuse:
+        print(
+            f"chunks: {reuse.get('chunks_total')} "
+            f"({reuse.get('chunks_written')} written, "
+            f"{reuse.get('chunks_reused')} deduped) | bytes "
+            f"{human(reuse.get('bytes_written', 0))} written, "
+            f"{human(reuse.get('bytes_reused', 0))} deduped "
+            f"@ {human(doc.get('chunk_bytes', 0))} chunk size"
+        )
+    if show_chunks:
+        store_root = chunkstore.chunks_root(path.parent)
+        for entry in doc.get("leaves", []):
+            missing = sum(
+                1 for d in entry["chunks"]
+                if not chunkstore.chunk_path(store_root, d).is_file()
+            )
+            state = "ok" if not missing else f"{missing} MISSING"
+            print(
+                f"  {entry['path']}: {len(entry['chunks'])} chunk(s), "
+                f"{entry['reused']} reused, {state}"
+            )
+            for d in entry["chunks"]:
+                print(f"    {d}")
+    return 0
+
+
 def inspect_sharded(path, show_leaves):
     from pyrecover_tpu.analysis.shardcheck.manifest import read_ckpt_manifest
 
@@ -257,6 +306,9 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("checkpoint")
     ap.add_argument("--leaves", action="store_true", help="list every leaf")
+    ap.add_argument("--chunks", action="store_true",
+                    help="zerostall checkpoints: list every leaf's chunk "
+                    "digests with dedup/presence state (the chunk view)")
     ap.add_argument(
         "--manifest", action="store_true",
         help="print the schema manifest JSON (paths/shapes/dtypes/pspecs) "
@@ -298,9 +350,13 @@ def main(argv=None):
                   file=sys.stderr)
             return 1
         return 0
+    from pyrecover_tpu.checkpoint.registry import engine_of
+
     if p.is_dir():
         inspect_sharded(p, args.leaves)
         return 0
+    if engine_of(p) == "zerostall":
+        return inspect_zerostall(p, args.leaves, args.chunks)
     return inspect_vanilla(p, args.leaves)
 
 

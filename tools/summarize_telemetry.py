@@ -89,8 +89,8 @@ def aggregate(events):
         }
         if s:
             for k in ("wall_s", "step_s", "productive_s", "replayed_s",
-                      "ckpt_save_s", "ckpt_load_s", "setup_s", "eval_s",
-                      "lost_s"):
+                      "ckpt_save_s", "ckpt_blocking_s", "ckpt_shadow_s",
+                      "ckpt_load_s", "setup_s", "eval_s", "lost_s"):
                 total[k] += float(s.get(k, 0.0))
             total["replayed_steps"] += int(s.get("replayed_steps", 0))
             row["goodput_pct"] = s.get("goodput_pct")
@@ -170,27 +170,44 @@ def aggregate(events):
     agg["health"] = health
 
     ckpt = {}
-    for e in by.get("ckpt_save_blocking", []):
-        eng = ckpt.setdefault(
+
+    def _ckpt_engine(e):
+        return ckpt.setdefault(
             e.get("engine", "?"),
             {"saves": 0, "blocking_s": 0.0, "blocking_s_max": 0.0,
-             "restores": 0, "restore_s": 0.0},
+             "shadow_s": 0.0, "restores": 0, "restore_s": 0.0},
         )
+
+    for e in by.get("ckpt_save_blocking", []):
+        eng = _ckpt_engine(e)
         eng["saves"] += 1
         eng["blocking_s"] += e["blocking_s"]
         eng["blocking_s_max"] = max(eng["blocking_s_max"], e["blocking_s"])
+    # overlapped background save work (async vanilla writes, the
+    # zerostall pipeline): recovered goodput, reported NEXT TO the
+    # blocking stall so an async engine's win is visible, never hidden
+    for e in by.get("ckpt_save_shadow", []):
+        _ckpt_engine(e)["shadow_s"] += e.get("shadow_s", 0.0)
     for e in by.get("ckpt_restore_done", []):
-        eng = ckpt.setdefault(
-            e.get("engine", "?"),
-            {"saves": 0, "blocking_s": 0.0, "blocking_s_max": 0.0,
-             "restores": 0, "restore_s": 0.0},
-        )
+        eng = _ckpt_engine(e)
         eng["restores"] += 1
         eng["restore_s"] += e["seconds"]
     for eng in ckpt.values():
-        for k in ("blocking_s", "blocking_s_max", "restore_s"):
+        for k in ("blocking_s", "blocking_s_max", "shadow_s", "restore_s"):
             eng[k] = round(eng[k], 4)
     agg["ckpt"] = ckpt
+    agg["ckpt_backpressure"] = {
+        "count": len(by.get("ckpt_backpressure", [])),
+        "wait_s": round(
+            sum(e.get("wait_s", 0.0)
+                for e in by.get("ckpt_backpressure", [])), 4
+        ),
+    }
+    agg["emergency"] = {
+        "publishes": len(by.get("emergency_publish", [])),
+        "restores": len(by.get("emergency_restore", [])),
+        "rejected": len(by.get("emergency_restore_rejected", [])),
+    }
     agg["ckpt_commits"] = {
         "count": len(by.get("ckpt_commit", [])),
         "bytes": sum(e.get("bytes", 0) for e in by.get("ckpt_commit", [])),
@@ -248,7 +265,11 @@ def render(agg, out=None):
         w(f"  wall time          {_fmt_s(t.get('wall_s', 0.0))}\n")
         w(f"  productive train   {_fmt_s(t.get('productive_s', 0.0))}"
           f"  <- stepping time that moved training forward once\n")
-        w(f"  lost: ckpt save    {_fmt_s(t.get('ckpt_save_s', 0.0))}\n")
+        w(f"  lost: ckpt save    {_fmt_s(t.get('ckpt_save_s', 0.0))}"
+          f"  <- blocking train-loop stall only\n")
+        if t.get("ckpt_shadow_s"):
+            w(f"  recovered: shadow  {_fmt_s(t.get('ckpt_shadow_s', 0.0))}"
+              f"  <- save work overlapped with training (not lost)\n")
         w(f"  lost: ckpt load    {_fmt_s(t.get('ckpt_load_s', 0.0))}\n")
         w(f"  lost: re-warmup    {_fmt_s(t.get('setup_s', 0.0))}\n")
         w(f"  lost: replayed     {_fmt_s(t.get('replayed_s', 0.0))}"
@@ -313,9 +334,23 @@ def render(agg, out=None):
     if agg["ckpt"]:
         w("\n-- checkpoint lifecycle ----------------------------------------\n")
         for eng, c in sorted(agg["ckpt"].items()):
+            shadow = (
+                f", shadow {c['shadow_s']}s overlapped"
+                if c.get("shadow_s") else ""
+            )
             w(f"  [{eng}] {c['saves']} saves, blocking {c['blocking_s']}s "
-              f"(max {c['blocking_s_max']}s); {c['restores']} restores, "
-              f"{c['restore_s']}s\n")
+              f"(max {c['blocking_s_max']}s{shadow}); {c['restores']} "
+              f"restores, {c['restore_s']}s\n")
+        bp = agg.get("ckpt_backpressure") or {}
+        if bp.get("count"):
+            w(f"  BACKPRESSURE: {bp['count']} save(s) waited "
+              f"{bp['wait_s']}s on the in-flight queue\n")
+        em = agg.get("emergency") or {}
+        if em.get("publishes") or em.get("restores") or em.get("rejected"):
+            w(f"  emergency tier: {em['publishes']} publishes, "
+              f"{em['restores']} RAM restores"
+              + (f", {em['rejected']} REJECTED records"
+                 if em.get("rejected") else "") + "\n")
         cm = agg["ckpt_commits"]
         if cm["count"]:
             w(f"  commits: {cm['count']} ({cm['bytes']} bytes, "
@@ -370,6 +405,8 @@ def main(argv=None):
                 "gauges": agg["gauges"],
                 "health": agg["health"],
                 "ckpt": agg["ckpt"],
+                "ckpt_backpressure": agg["ckpt_backpressure"],
+                "emergency": agg["emergency"],
                 "data_stalls": agg["data_stalls"],
                 "preempt": agg["preempt"],
             },
