@@ -43,6 +43,18 @@ fi
 python tools/jaxlint.py pyrecover_tpu tools bench.py __graft_entry__.py \
   --strict --json "${JAXLINT_JSON:-/tmp/jaxlint_report.json}" || rc=1
 
+# concur: static concurrency-safety analysis (pyrecover_tpu/analysis/concur
+# — pure stdlib, same engine/suppression machinery as jaxlint under the
+# `concur:` namespace). Machine-checks the threading invariants the async
+# checkpoint stack documents in prose: no blocking I/O under hot-path
+# locks (CC02), no lock-order inversions across thread roots (CC01), no
+# unguarded cross-root shared state (CC03), signal handlers stay
+# lock/emit-free (CC04), daemon writers that own durable commits are
+# joined (CC05), collectives stay pinned to the calling thread (CC06).
+# JSON report beside the jaxlint one (CONCUR_JSON).
+python tools/concur.py pyrecover_tpu tools bench.py __graft_entry__.py \
+  --strict --json "${CONCUR_JSON:-/tmp/concur_report.json}" || rc=1
+
 # shardcheck: abstract SPMD preflight (pyrecover_tpu/analysis/shardcheck).
 # Every shipped preset must validate clean — partition-spec divisibility,
 # axis use, replication, collective census — on 1/2/4/8-device virtual

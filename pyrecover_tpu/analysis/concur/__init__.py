@@ -1,0 +1,68 @@
+"""concur — static concurrency-safety analysis for the async training stack.
+
+jaxlint checks JAX *syntax* hazards and shardcheck checks SPMD *launch
+semantics*; this package checks the THREADING semantics the resilient
+training stack lives and dies by. PRs 4–8 made pyrecover_tpu heavily
+threaded — the zerostall snapshot writer, the emergency RAM tier, the
+loader producer, the maintenance long-poller, the hang watchdog, the
+flight-recorder hooks, and the telemetry sinks together hold ~19 locks,
+daemon threads, and signal/excepthook entry points — and the paper's core
+promise ("a checkpoint survives being interrupted at any instant") is
+exactly a concurrency claim. Invariants like *"blocking actions never run
+under the engine lock"* and *"collectives stay pinned to the calling
+thread"* were enforced only by comments and reviewer memory; concur makes
+them machine-checked on every commit.
+
+The analyzer reuses jaxlint's engine end to end: the same
+:class:`~pyrecover_tpu.analysis.engine.ModuleInfo` parsing, the same
+cross-module call graph (:mod:`pyrecover_tpu.analysis.callgraph`), the
+same suppression syntax under the ``concur:`` comment namespace, and the
+same text/JSON reporters. It builds two project-wide facts first:
+
+* **thread roots** — every ``threading.Thread(target=...)`` spawn, every
+  ``signal.signal`` handler registration, every ``sys.excepthook`` /
+  ``threading.excepthook`` assignment, every ``atexit.register`` hook,
+  plus the *main* root (functions named in ``entry_seeds`` and
+  ``# jaxlint: hot-loop``-marked seeds) — each with its transitive
+  call-graph reachability;
+* **a lock model** — module-level and ``self``-attribute
+  ``threading.Lock/RLock/Condition`` objects, their ``with lock:``
+  regions and linear ``.acquire()``/``.release()`` pairs, and the
+  acquired-while-holding edges between them.
+
+The rule catalog (``rules.py``): CC01 lock-order-inversion, CC02
+blocking-under-lock, CC03 unguarded-shared-state, CC04 signal-unsafe-call,
+CC05 daemon-durable-io, CC06 unpinned-collective.
+
+Suppressions carry the same shape as jaxlint's, under the ``concur:``
+namespace, and the test suite rejects justification-free ones::
+
+    check = engine.check   # concur: disable=unguarded-shared-state -- why
+
+A ``# concur: guarded-by=<lock>`` marker declares guarding intent for
+shared-state sites whose lock discipline the linear analysis cannot see
+(e.g. a mutation inside a callee whose caller holds the lock). The marker
+names a lock by suffix (``guarded-by=_bootstrap_lock`` matches
+``resilience.faults._bootstrap_lock``) and applies to the line it sits
+on, or to every site in a function when placed on its ``def`` line.
+
+CLI: ``tools/concur.py`` (console script ``concur``), gated in
+``format.sh`` with ``--strict`` over the whole repo.
+"""
+
+from pyrecover_tpu.analysis.concur.model import ConcurConfig, ConcurModel
+from pyrecover_tpu.analysis.concur.rules import (
+    CC_RULES,
+    analyze_modules,
+    analyze_paths,
+    analyze_source,
+)
+
+__all__ = [
+    "CC_RULES",
+    "ConcurConfig",
+    "ConcurModel",
+    "analyze_modules",
+    "analyze_paths",
+    "analyze_source",
+]

@@ -1,34 +1,34 @@
-"""jaxlint command line (the engine behind ``tools/jaxlint.py``).
+"""concur command line (the engine behind ``tools/concur.py``).
 
-Exit codes: 0 clean (or report-only mode), 1 unsuppressed findings under
-``--strict``, 2 usage/engine error.
+Mirrors the jaxlint CLI contract exactly — same flags, same exit codes
+(0 clean / report-only, 1 unsuppressed findings under ``--strict``, 2
+usage error), same text/JSON report shapes — so CI tooling consumes all
+three analyzers (jaxlint, shardcheck, concur) with one set of plumbing.
 """
 
 import argparse
 import sys
 from pathlib import Path
 
-from pyrecover_tpu.analysis.engine import (
-    DEFAULT_CONFIG,
-    LintConfig,
-    lint_paths,
-)
+from pyrecover_tpu.analysis.concur.model import ConcurConfig
+from pyrecover_tpu.analysis.concur.rules import CC_RULES, analyze_paths
 from pyrecover_tpu.analysis.report import render_json, render_text
 
 
 def _build_parser():
     p = argparse.ArgumentParser(
-        prog="jaxlint",
+        prog="concur",
         description=(
-            "JAX-aware static analysis: host syncs in the hot loop, PRNG "
-            "key reuse, donated-buffer reads, traced-value branching, side "
-            "effects under jit, non-hashable static args, unsynced timing "
-            "spans, legacy jax spellings, unknown PartitionSpec axes."
+            "Static concurrency-safety analysis for the async training "
+            "stack: lock-order inversions, blocking I/O under hot-path "
+            "locks, shared state mutated from several thread roots, "
+            "signal-unsafe calls, unjoined daemon writers, collectives "
+            "dispatched off the registering thread."
         ),
     )
     p.add_argument(
         "paths", nargs="*", default=["pyrecover_tpu"],
-        help="files or directories to lint (default: pyrecover_tpu)",
+        help="files or directories to analyze (default: pyrecover_tpu)",
     )
     p.add_argument(
         "--strict", action="store_true",
@@ -69,36 +69,34 @@ def _csv_set(raw):
 def main(argv=None):
     args = _build_parser().parse_args(argv)
 
-    from pyrecover_tpu.analysis.rules import RULES
-
     if args.list_rules:
-        for r in RULES.values():
-            print(f"{r.id}  {r.name:<24} {r.severity:<7} {r.summary}")
+        for r in CC_RULES.values():
+            print(f"{r.id}  {r.name:<26} {r.severity:<7} {r.summary}")
         return 0
 
-    config = DEFAULT_CONFIG
+    config = ConcurConfig()
     if args.select or args.ignore:
-        config = LintConfig(
+        config = ConcurConfig(
             select=_csv_set(args.select) if args.select else None,
             ignore=_csv_set(args.ignore) if args.ignore else frozenset(),
         )
 
     missing = [p for p in args.paths if not Path(p).exists()]
     if missing:
-        print(f"jaxlint: no such path: {', '.join(missing)}", file=sys.stderr)
+        print(f"concur: no such path: {', '.join(missing)}", file=sys.stderr)
         return 2
 
-    result = lint_paths(args.paths, config)
+    result = analyze_paths(args.paths, config)
 
     if args.json:
-        # jaxlint: disable-next=torn-write -- CI report artifact, regenerated
-        # every run; a torn report fails its consumer loudly and is simply
-        # re-produced
+        # jaxlint: disable-next=torn-write -- CI report artifact,
+        # regenerated every run; a torn report fails its consumer loudly
         Path(args.json).write_text(
-            render_json(result, strict=args.strict) + "\n", encoding="utf-8"
+            render_json(result, strict=args.strict, tool="concur") + "\n",
+            encoding="utf-8",
         )
     if args.format == "json":
-        print(render_json(result, strict=args.strict))
+        print(render_json(result, strict=args.strict, tool="concur"))
     else:
         print(render_text(result, show_suppressed=args.show_suppressed))
 

@@ -1,0 +1,816 @@
+"""Concurrency model extraction: thread roots, locks, per-function facts.
+
+Everything the rule catalog consumes is computed here, once, from the
+same :class:`~pyrecover_tpu.analysis.engine.ModuleInfo` parse jaxlint
+uses:
+
+* **Locks** — module-level ``NAME = threading.Lock()`` (also RLock /
+  Condition / Semaphore) and instance-level ``self.NAME = threading.Lock()``
+  assignments. Lock identity is ``<dotted.module>.<name>`` for module
+  locks and ``<ClassName>.<attr>`` for instance locks, so the
+  acquired-while-holding graph spans modules.
+* **Held regions** — ``with lock:`` blocks (line spans) and linear
+  ``.acquire()``/``.release()`` pairs within one function. Acquisitions
+  carry a sequence order so ``with a, b:`` yields the edge a→b and never
+  the phantom reverse edge.
+* **Thread roots** — every ``threading.Thread(target=...)`` spawn (with
+  its daemon flag and the names/attributes the thread object is bound
+  to, for join matching), ``signal.signal`` handler registrations,
+  ``sys.excepthook``/``threading.excepthook`` assignments,
+  ``atexit.register`` hooks, and the *main* root seeded by
+  ``entry_seeds`` plus ``# jaxlint: hot-loop`` markers. Each root gets a
+  transitive call-graph reachability set (jitted functions excluded —
+  device code has no host concurrency; nested defs are followed, but a
+  nested def that is itself a registered root entry belongs to ITS root,
+  not the parent's).
+* **Per-function facts** — direct lock acquisitions, blocking calls
+  (file I/O, fsync, sleep, subprocess, ``block_until_ready``),
+  cross-host collectives, durable commit-path operations
+  (fsync/rename/replace), shared-state mutations (module globals and
+  ``self`` attributes outside ``__init__``), and ``emit()`` calls.
+
+The call resolution is jaxlint's (:meth:`ProjectIndex.resolve_call`)
+extended with one edge the engine's resolver misses: ``mod.fn(...)``
+where ``mod`` arrived via ``from package import mod`` — the dominant
+import style in this codebase (``from ... import chunkstore`` then
+``chunkstore.write_leaf(...)``).
+"""
+
+import ast
+import dataclasses
+
+from pyrecover_tpu.analysis.callgraph import ProjectIndex, dotted_name
+from pyrecover_tpu.analysis.engine import DEFAULT_CONFIG
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+_INIT_NAMES = {"__init__", "__post_init__", "__new__"}
+
+# blocking operations (CC02): anything that can hold a lock for an
+# unbounded or I/O-scale time while other threads spin on it
+_BLOCKING_DOTTED = {
+    "time.sleep", "os.fsync", "os.replace", "os.rename", "os.unlink",
+    "shutil.move", "shutil.copy", "shutil.copyfile", "shutil.copytree",
+    "shutil.rmtree", "urllib.request.urlopen", "socket.create_connection",
+}
+_BLOCKING_PREFIXES = ("subprocess.",)
+_BLOCKING_ATTRS = {
+    "write_text", "write_bytes", "read_text", "read_bytes", "fsync",
+    "block_until_ready", "urlopen",
+}
+
+# cross-host collectives (CC02 treats them as blocking; CC06 pins them to
+# the registering thread)
+_COLLECTIVE_NAMES = {
+    "process_allgather", "broadcast_one_to_all", "sync_global_devices",
+    "broadcast_host0_scalar", "psum", "pmean", "pmax", "pmin",
+    "all_gather", "all_to_all", "ppermute", "pbroadcast",
+}
+
+# durable commit-path operations (CC05): the tmp+fsync+rename discipline's
+# observable footprint — a daemon thread that owns these must be joined
+_DURABLE_DOTTED = {"os.fsync", "os.replace", "os.rename"}
+_DURABLE_ATTRS = {"fsync"}
+
+# method calls that mutate their receiver in place (shared-state tracking
+# on module-level globals)
+_MUTATORS = {
+    "append", "appendleft", "add", "update", "setdefault", "pop",
+    "popleft", "clear", "extend", "remove", "insert", "discard",
+}
+
+
+@dataclasses.dataclass
+class ConcurConfig:
+    """Rule selection + project knowledge for the concurrency analysis."""
+
+    select: frozenset = None
+    ignore: frozenset = frozenset()
+    # main-thread reachability seeds (jaxlint ``hot-loop`` markers add to
+    # this set); "main" covers every tool entry point in tools/
+    entry_seeds: frozenset = frozenset({"main", "train", "_train_impl"})
+    # the jaxlint LintConfig supplying the fuzzy-method blacklist for
+    # call resolution; `result` is added because `Future.result()` (the
+    # loader's thread pool) would otherwise fuzzy-resolve to whatever
+    # single project method happens to be named `result`
+    lint: object = dataclasses.field(
+        default_factory=lambda: dataclasses.replace(
+            DEFAULT_CONFIG,
+            fuzzy_method_blacklist=(
+                DEFAULT_CONFIG.fuzzy_method_blacklist | {"result"}
+            ),
+        )
+    )
+
+    def rule_enabled(self, name, rule_id):
+        if name in self.ignore or rule_id in self.ignore:
+            return False
+        if self.select is None:
+            return True
+        return name in self.select or rule_id in self.select
+
+
+DEFAULT_CONCUR_CONFIG = ConcurConfig()
+
+
+@dataclasses.dataclass
+class Region:
+    """One held-lock span inside a function (line-range approximation)."""
+
+    lock: str
+    order: int  # acquisition sequence number within the function
+    start: int
+    end: int
+    node: object
+
+
+@dataclasses.dataclass
+class FuncFacts:
+    """Everything one function contributes to the concurrency model."""
+
+    regions: list = dataclasses.field(default_factory=list)
+    acquires: list = dataclasses.field(default_factory=list)  # (lock, node, order)
+    calls: list = dataclasses.field(default_factory=list)  # (node, target|None)
+    blocking: list = dataclasses.field(default_factory=list)  # (node, desc)
+    collectives: list = dataclasses.field(default_factory=list)  # (node, desc)
+    durables: list = dataclasses.field(default_factory=list)  # (node, desc)
+    mutations: list = dataclasses.field(default_factory=list)  # (shared_id, node)
+    emits: list = dataclasses.field(default_factory=list)  # nodes
+
+    def held_at(self, node):
+        line = getattr(node, "lineno", 0)
+        return {
+            r.lock for r in self.regions if r.start <= line <= r.end
+        }
+
+
+@dataclasses.dataclass
+class Root:
+    """One concurrent entry point and its call-graph reachability."""
+
+    kind: str  # "main" | "thread" | "signal" | "hook" | "atexit"
+    name: str
+    entries: tuple
+    module: object = None  # registration site (None for the main root)
+    node: object = None
+    daemon: bool = False
+    bindings: frozenset = frozenset()  # thread-object bindings, for joins
+    reach: frozenset = frozenset()
+
+
+def _module_dotted(module):
+    rel = str(module.relpath).replace("\\", "/")
+    if rel.endswith(".py"):
+        rel = rel[:-3]
+    if rel.endswith("/__init__"):
+        rel = rel[: -len("/__init__")]
+    return rel.replace("/", ".")
+
+
+def _enclosing_class(module, node):
+    for anc in module.ancestors(node):
+        if isinstance(anc, ast.ClassDef):
+            return anc.name
+    return None
+
+
+def _stmts_in(module, fn_node):
+    out = [
+        n for n in ast.walk(fn_node)
+        if isinstance(n, ast.stmt) and n is not fn_node
+        and module.enclosing_function(n) is fn_node
+    ]
+    out.sort(key=lambda n: (n.lineno, n.col_offset))
+    return out
+
+
+def _last_component(call):
+    d = dotted_name(call.func)
+    if d is not None:
+        return d.rsplit(".", 1)[-1]
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return None
+
+
+def _blocking_desc(call):
+    d = dotted_name(call.func)
+    if d is not None:
+        if d in _BLOCKING_DOTTED or d.startswith(_BLOCKING_PREFIXES):
+            return f"{d}()"
+        if d == "open":
+            return "open()"
+    if isinstance(call.func, ast.Attribute) and call.func.attr in _BLOCKING_ATTRS:
+        return f".{call.func.attr}()"
+    return None
+
+
+def _collective_desc(call):
+    last = _last_component(call)
+    if last in _COLLECTIVE_NAMES:
+        return f"{last}()"
+    return None
+
+
+def _durable_desc(call):
+    d = dotted_name(call.func)
+    if d in _DURABLE_DOTTED:
+        return f"{d}()"
+    if isinstance(call.func, ast.Attribute) and call.func.attr in _DURABLE_ATTRS:
+        return f".{call.func.attr}()"
+    return None
+
+
+class ConcurModel:
+    """Project-wide concurrency facts; built once, consumed by every rule."""
+
+    def __init__(self, modules, config=None):
+        self.config = config or DEFAULT_CONCUR_CONFIG
+        self.index = ProjectIndex(modules)
+        self.modules = list(modules)
+        self.by_path = {m.relpath: m for m in self.modules}
+        self.modq = {m: _module_dotted(m) for m in self.modules}
+        self.locks = {}  # lock id -> (module, node)
+        self.thread_locals = set()  # global ids bound to threading.local()
+        self.module_globals = {}  # module -> set of module-level names
+        self._discover_globals_and_locks()
+        self.facts = {}  # FunctionInfo -> FuncFacts
+        for fn in self.index.functions:
+            self.facts[fn] = self._function_facts(fn)
+        self._acq_closure = {}
+        self._blocking_closure = {}
+        self._durable_closure = {}
+        self.joins_global = set()  # ("attr", A) / ("clsattr", C, A)
+        self.joins_local = {}  # FunctionInfo|None -> set of joined var names
+        self._collect_joins()
+        self.roots = self._discover_roots()
+        self.roots_of = {}  # FunctionInfo -> set of root names
+        for root in self.roots:
+            for fn in root.reach:
+                self.roots_of.setdefault(fn, set()).add(root.name)
+
+    # ---- globals + locks ---------------------------------------------------
+
+    def _discover_globals_and_locks(self):
+        for module in self.modules:
+            names = set()
+            for stmt in module.tree.body:
+                targets = []
+                if isinstance(stmt, ast.Assign):
+                    targets = stmt.targets
+                elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+                    targets = [stmt.target]
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+                        value = getattr(stmt, "value", None)
+                        if isinstance(value, ast.Call):
+                            if self._is_lock_ctor(module, value):
+                                lid = f"{self.modq[module]}.{t.id}"
+                                self.locks[lid] = (module, stmt)
+                            elif dotted_name(value.func) in (
+                                "threading.local",
+                            ):
+                                self.thread_locals.add(
+                                    f"{self.modq[module]}.{t.id}"
+                                )
+            # `global NAME` declarations are module-level bindings too
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.Global):
+                    names.update(node.names)
+            self.module_globals[module] = names
+        # instance locks: self.<attr> = threading.Lock() anywhere
+        for module in self.modules:
+            for node in ast.walk(module.tree):
+                if not (
+                    isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and self._is_lock_ctor(module, node.value)
+                ):
+                    continue
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        cls = _enclosing_class(module, node)
+                        owner = cls or self.modq[module]
+                        self.locks[f"{owner}.{t.attr}"] = (module, node)
+
+    def _is_lock_ctor(self, module, call):
+        d = dotted_name(call.func)
+        if d is not None and d.startswith("threading.") and \
+                d.split(".", 1)[1] in _LOCK_CTORS:
+            return True
+        if isinstance(call.func, ast.Name):
+            imp = self.index.from_imports.get(module, {}).get(call.func.id)
+            if imp is not None and imp[0] == "threading" and \
+                    imp[1] in _LOCK_CTORS:
+                return True
+        return False
+
+    def _module_by_dotted(self, mod_dotted):
+        if not mod_dotted:
+            return None
+        tail = mod_dotted.replace(".", "/") + ".py"
+        init_tail = mod_dotted.replace(".", "/") + "/__init__.py"
+        for m in self.modules:
+            rel = str(m.relpath).replace("\\", "/")
+            if rel.endswith(tail) or rel.endswith(init_tail):
+                return m
+        return None
+
+    def resolve_lock(self, module, at_node, expr):
+        """Lock id a ``with``/``.acquire()`` expression refers to, or None."""
+        if isinstance(expr, ast.Name):
+            lid = f"{self.modq[module]}.{expr.id}"
+            if lid in self.locks:
+                return lid
+            imp = self.index.from_imports.get(module, {}).get(expr.id)
+            if imp is not None:
+                target = self._module_by_dotted(imp[0])
+                if target is not None:
+                    lid = f"{self.modq[target]}.{imp[1]}"
+                    if lid in self.locks:
+                        return lid
+            return None
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name):
+                if base.id == "self":
+                    cls = _enclosing_class(module, expr)
+                    if cls is not None:
+                        lid = f"{cls}.{expr.attr}"
+                        if lid in self.locks:
+                            return lid
+                alias = self.index.import_aliases.get(module, {}).get(base.id)
+                from_imp = self.index.from_imports.get(module, {}).get(base.id)
+                target_dotted = alias or (
+                    f"{from_imp[0]}.{from_imp[1]}" if from_imp else None
+                )
+                if target_dotted:
+                    target = self._module_by_dotted(target_dotted)
+                    if target is not None:
+                        lid = f"{self.modq[target]}.{expr.attr}"
+                        if lid in self.locks:
+                            return lid
+            # unique suffix match (e.g. a lock attribute on a passed-in
+            # object); ambiguous suffixes resolve to nothing
+            cands = [
+                lid for lid in self.locks if lid.endswith(f".{expr.attr}")
+            ]
+            if len(cands) == 1:
+                return cands[0]
+        return None
+
+    def marker_locks(self, module, fn, node):
+        """Locks declared by ``# concur: guarded-by=<lock>`` markers that
+        apply to ``node``: on its own line, on the opening line of its
+        statement, or on the enclosing ``def`` (function-wide intent)."""
+        line = getattr(node, "lineno", 0)
+        lines = {line, module.stmt_start.get(line, line)}
+        if fn is not None:
+            lines.update({fn.node.lineno, fn.node.lineno - 1})
+        out = set()
+        for ln in lines:
+            for marker in module.markers.get(ln, ()):
+                if not marker.startswith("guarded-by="):
+                    continue
+                value = marker.split("=", 1)[1]
+                matches = [
+                    lid for lid in self.locks
+                    if lid == value or lid.endswith(f".{value}")
+                ]
+                out.add(matches[0] if len(matches) == 1 else value)
+        return out
+
+    # ---- per-function facts ------------------------------------------------
+
+    def _resolve_call(self, module, call):
+        """jaxlint's resolver + the ``from pkg import mod; mod.fn()`` edge."""
+        target = self.index.resolve_call(module, call, self.config.lint)
+        if target is not None:
+            return target
+        func = call.func
+        if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+            imp = self.index.from_imports.get(module, {}).get(func.value.id)
+            if imp is not None:
+                mod_dotted = f"{imp[0]}.{imp[1]}" if imp[0] else imp[1]
+                return self.index._project_function(mod_dotted, func.attr)
+        return None
+
+    def _function_facts(self, fn):
+        module = fn.module
+        facts = FuncFacts()
+        order = 0
+        open_acquires = {}  # lock id -> Region (awaiting release)
+        for stmt in _stmts_in(module, fn.node):
+            if isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    lock = self.resolve_lock(module, fn.node, item.context_expr)
+                    if lock is not None:
+                        order += 1
+                        facts.acquires.append((lock, stmt, order))
+                        facts.regions.append(Region(
+                            lock, order, stmt.lineno,
+                            stmt.end_lineno or stmt.lineno, stmt,
+                        ))
+            for call in self._stmt_calls(module, stmt, fn.node):
+                func = call.func
+                if isinstance(func, ast.Attribute) and func.attr in (
+                    "acquire", "release"
+                ):
+                    lock = self.resolve_lock(module, fn.node, func.value)
+                    if lock is not None:
+                        if func.attr == "acquire":
+                            order += 1
+                            region = Region(
+                                lock, order, call.lineno,
+                                fn.node.end_lineno or call.lineno, call,
+                            )
+                            facts.acquires.append((lock, call, order))
+                            facts.regions.append(region)
+                            open_acquires[lock] = region
+                        else:
+                            region = open_acquires.pop(lock, None)
+                            if region is not None:
+                                region.end = call.lineno
+                        continue
+                target = self._resolve_call(module, call)
+                facts.calls.append((call, target))
+                desc = _blocking_desc(call)
+                if desc:
+                    facts.blocking.append((call, desc))
+                desc = _collective_desc(call)
+                if desc:
+                    facts.collectives.append((call, desc))
+                desc = _durable_desc(call)
+                if desc:
+                    facts.durables.append((call, desc))
+                last = _last_component(call)
+                if last == "emit":
+                    facts.emits.append(call)
+                # mutating method call on a module-level global
+                if (
+                    isinstance(func, ast.Attribute)
+                    and func.attr in _MUTATORS
+                    and isinstance(func.value, ast.Name)
+                ):
+                    sid = self._global_id(module, func.value.id)
+                    if sid is not None:
+                        facts.mutations.append((sid, call))
+            self._stmt_mutations(module, fn, stmt, facts)
+        return facts
+
+    def _stmt_calls(self, module, stmt, fn_node):
+        for n in ast.walk(stmt):
+            if isinstance(n, ast.Call) and \
+                    module.enclosing_function(n) is fn_node and \
+                    self._innermost_stmt(module, n) is stmt:
+                yield n
+
+    @staticmethod
+    def _innermost_stmt(module, node):
+        for anc in module.ancestors(node):
+            if isinstance(anc, ast.stmt):
+                return anc
+        return None
+
+    def _global_id(self, module, name):
+        """Shared-state id for a module-level global, or None for names
+        that are not shared state (locks guard, thread-locals isolate)."""
+        if name not in self.module_globals.get(module, ()):
+            return None
+        sid = f"{self.modq[module]}.{name}"
+        if sid in self.locks or sid in self.thread_locals:
+            return None
+        return sid
+
+    def _stmt_mutations(self, module, fn, stmt, facts):
+        if fn.name in _INIT_NAMES:
+            return  # construction happens-before any thread can observe
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        global_decls = {
+            n for g in ast.walk(fn.node) if isinstance(g, ast.Global)
+            for n in g.names
+        }
+        for t in targets:
+            base = t
+            if isinstance(base, ast.Subscript):
+                base = base.value
+            if isinstance(base, ast.Name):
+                if base.id in global_decls or isinstance(t, ast.Subscript):
+                    sid = self._global_id(module, base.id)
+                    if sid is not None:
+                        facts.mutations.append((sid, stmt))
+            elif isinstance(base, ast.Attribute) and isinstance(
+                base.value, ast.Name
+            ):
+                owner = base.value.id
+                if owner == "self" and fn.is_method:
+                    cls = _enclosing_class(module, stmt)
+                    if cls is not None:
+                        facts.mutations.append((f"{cls}.{base.attr}", stmt))
+                elif owner != "self":
+                    sid = self._global_id(module, owner)
+                    if sid is not None:
+                        facts.mutations.append((sid, stmt))
+
+    # ---- transitive closures -----------------------------------------------
+
+    def _closure(self, fn, cache, direct):
+        if fn in cache:
+            return cache[fn]
+        cache[fn] = ()  # cycle guard: in-progress nodes contribute nothing
+        out = list(direct(fn))
+        seen_children = set()
+        for _, target in self.facts[fn].calls:
+            if target is not None and target not in seen_children:
+                seen_children.add(target)
+                out.extend(self._closure(target, cache, direct))
+        for nested in self.index.by_module.get(fn.module, ()):
+            if nested.parent is fn and nested not in seen_children:
+                out.extend(self._closure(nested, cache, direct))
+        # dedupe, keep first occurrence (closest site)
+        deduped, seen = [], set()
+        for item in out:
+            if item[0] not in seen:
+                seen.add(item[0])
+                deduped.append(item)
+        cache[fn] = tuple(deduped)
+        return cache[fn]
+
+    def acquires_closure(self, fn):
+        """((lock_id, via_qualname), ...) transitively acquired by ``fn``."""
+        return self._closure(
+            fn, self._acq_closure,
+            lambda f: [(lock, f.qualname) for lock, _, _ in
+                       self.facts[f].acquires],
+        )
+
+    def blocking_closure(self, fn):
+        """((desc, via_qualname), ...) blocking ops ``fn`` eventually runs
+        (collectives included — they block on the slowest host)."""
+        return self._closure(
+            fn, self._blocking_closure,
+            lambda f: [(d, f.qualname) for _, d in self.facts[f].blocking]
+            + [(d, f.qualname) for _, d in self.facts[f].collectives],
+        )
+
+    def durable_closure(self, fn):
+        """((desc, via_qualname), ...) durable commit-path ops."""
+        return self._closure(
+            fn, self._durable_closure,
+            lambda f: [(d, f.qualname) for _, d in self.facts[f].durables],
+        )
+
+    # ---- joins -------------------------------------------------------------
+
+    def _collect_joins(self):
+        for module in self.modules:
+            for node in ast.walk(module.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "join"
+                ):
+                    continue
+                recv = node.func.value
+                if isinstance(recv, ast.Name):
+                    fn_node = module.enclosing_function(node)
+                    fn = self.index.by_node.get(fn_node)
+                    self.joins_local.setdefault(fn, set()).add(recv.id)
+                elif isinstance(recv, ast.Attribute):
+                    self.joins_global.add(("attr", recv.attr))
+                    if isinstance(recv.value, ast.Name) and \
+                            recv.value.id == "self":
+                        cls = _enclosing_class(module, node)
+                        if cls is not None:
+                            self.joins_global.add(("clsattr", cls, recv.attr))
+
+    def thread_is_joined(self, root):
+        """Best-effort: is some ``.join()`` call wired to this thread's
+        binding? Instance-attribute bindings (``self._thread = t``) demand
+        a join in the SAME class; plain names match joins in the spawning
+        function; foreign-attribute bindings (``handle._thread = t``)
+        match any ``._thread.join()`` in the project."""
+        for key in root.bindings:
+            if key[0] == "name":
+                fn = self.index.by_node.get(
+                    root.module.enclosing_function(root.node)
+                )
+                if key[1] in self.joins_local.get(fn, ()):
+                    return True
+            elif key[0] == "clsattr":
+                if key in self.joins_global:
+                    return True
+            elif key[0] == "attr":
+                if ("attr", key[1]) in self.joins_global:
+                    return True
+        return False
+
+    # ---- roots -------------------------------------------------------------
+
+    def _resolve_func_expr(self, module, at_node, expr):
+        if isinstance(expr, ast.Name):
+            return self.index.resolve_local(module, at_node, expr.id)
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+                cls = _enclosing_class(module, at_node)
+                cands = [
+                    fi for fi in self.index.by_module.get(module, ())
+                    if fi.name == expr.attr
+                ]
+                if cls is not None:
+                    scoped = [
+                        fi for fi in cands
+                        if fi.qualname.startswith(f"{cls}.")
+                    ]
+                    if len(scoped) == 1:
+                        return scoped[0]
+                if len(cands) == 1:
+                    return cands[0]
+            d = dotted_name(expr)
+            if d is not None and "." in d:
+                base, _, attr = d.rpartition(".")
+                alias = self.index.import_aliases.get(module, {}).get(base)
+                if alias:
+                    return self.index._project_function(alias, attr)
+                imp = self.index.from_imports.get(module, {}).get(base)
+                if imp is not None:
+                    mod_dotted = f"{imp[0]}.{imp[1]}" if imp[0] else imp[1]
+                    return self.index._project_function(mod_dotted, attr)
+            cands = self.index.by_name.get(
+                expr.attr if isinstance(expr, ast.Attribute) else None, ()
+            )
+            if len(cands) == 1:
+                return cands[0]
+        return None
+
+    def _is_thread_ctor(self, module, call):
+        d = dotted_name(call.func)
+        if d == "threading.Thread":
+            return True
+        if isinstance(call.func, ast.Name):
+            imp = self.index.from_imports.get(module, {}).get(call.func.id)
+            return imp == ("threading", "Thread")
+        return False
+
+    def _thread_bindings(self, module, call):
+        """Names/attributes the spawned thread object flows into, within
+        the spawning scope — the join-matching keys."""
+        bindings = set()
+        daemon_late = False
+        stmt = self._innermost_stmt(module, call)
+        names = set()
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+                    bindings.add(("name", t.id))
+                elif isinstance(t, ast.Attribute):
+                    if isinstance(t.value, ast.Name) and t.value.id == "self":
+                        cls = _enclosing_class(module, stmt)
+                        if cls is not None:
+                            bindings.add(("clsattr", cls, t.attr))
+                        else:
+                            bindings.add(("attr", t.attr))
+                    else:
+                        bindings.add(("attr", t.attr))
+        fn_node = module.enclosing_function(call)
+        scope = fn_node if fn_node is not None else module.tree
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Assign):
+                continue
+            if isinstance(node.value, ast.Name) and node.value.id in names:
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute):
+                        if isinstance(t.value, ast.Name) and \
+                                t.value.id == "self":
+                            cls = _enclosing_class(module, node)
+                            if cls is not None:
+                                bindings.add(("clsattr", cls, t.attr))
+                                continue
+                        bindings.add(("attr", t.attr))
+                    elif isinstance(t, ast.Name):
+                        bindings.add(("name", t.id))
+            # late daemonization: t.daemon = True
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Attribute) and t.attr == "daemon"
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id in names
+                    and isinstance(node.value, ast.Constant)
+                    and node.value.value
+                ):
+                    daemon_late = True
+        return bindings, daemon_late
+
+    def _discover_roots(self):
+        specs = []  # (kind, entry, module, node, daemon, bindings)
+        for module in self.modules:
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.Call):
+                    if self._is_thread_ctor(module, node):
+                        target = None
+                        daemon = False
+                        for kw in node.keywords:
+                            if kw.arg == "target":
+                                target = self._resolve_func_expr(
+                                    module, node, kw.value
+                                )
+                            elif kw.arg == "daemon" and isinstance(
+                                kw.value, ast.Constant
+                            ):
+                                daemon = bool(kw.value.value)
+                        bindings, daemon_late = self._thread_bindings(
+                            module, node
+                        )
+                        if target is not None:
+                            specs.append((
+                                "thread", target, module, node,
+                                daemon or daemon_late, bindings,
+                            ))
+                    elif dotted_name(node.func) == "signal.signal" and \
+                            len(node.args) >= 2:
+                        handler = self._resolve_func_expr(
+                            module, node, node.args[1]
+                        )
+                        if handler is not None:
+                            specs.append((
+                                "signal", handler, module, node, False,
+                                frozenset(),
+                            ))
+                    elif dotted_name(node.func) == "atexit.register" and \
+                            node.args:
+                        target = self._resolve_func_expr(
+                            module, node, node.args[0]
+                        )
+                        if target is not None:
+                            specs.append((
+                                "atexit", target, module, node, False,
+                                frozenset(),
+                            ))
+                elif isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if dotted_name(t) in (
+                            "sys.excepthook", "threading.excepthook"
+                        ):
+                            target = self._resolve_func_expr(
+                                module, node, node.value
+                            )
+                            if target is not None:
+                                specs.append((
+                                    "hook", target, module, node, False,
+                                    frozenset(),
+                                ))
+        root_entries = {entry for _, entry, *_ in specs}
+        roots = []
+        seen_names = {}
+        for kind, entry, module, node, daemon, bindings in specs:
+            name = f"{kind}:{entry.qualname}"
+            if name in seen_names:
+                # same target spawned from several sites: one root, but
+                # keep the daemon flag / bindings of every site
+                root = seen_names[name]
+                root.daemon = root.daemon or daemon
+                root.bindings = root.bindings | frozenset(bindings)
+                continue
+            root = Root(
+                kind=kind, name=name, entries=(entry,), module=module,
+                node=node, daemon=daemon, bindings=frozenset(bindings),
+            )
+            root.reach = frozenset(self._reach([entry], root_entries))
+            seen_names[name] = root
+            roots.append(root)
+        mains = tuple(
+            fn for fn in self.index.functions
+            if fn.name in self.config.entry_seeds or "hot-loop" in fn.markers
+        )
+        main = Root(kind="main", name="main", entries=mains)
+        main.reach = frozenset(self._reach(list(mains), root_entries))
+        return [main] + roots
+
+    def _reach(self, entries, root_entries):
+        seen, queue = set(), list(entries)
+        while queue:
+            fn = queue.pop()
+            if fn in seen or fn.is_jit:
+                continue
+            seen.add(fn)
+            for _, target in self.facts[fn].calls:
+                if target is not None:
+                    queue.append(target)
+            # nested defs (closures, callbacks) run on this root too —
+            # unless they are themselves a registered root entry, in which
+            # case they belong to THAT root
+            for nested in self.index.by_module.get(fn.module, ()):
+                if nested.parent is fn and (
+                    nested in entries or nested not in root_entries
+                ):
+                    queue.append(nested)
+        return seen

@@ -1,0 +1,387 @@
+"""The concur rule catalog: CC01–CC06 over the extracted model.
+
+Rules are project-level (they consume the cross-module
+:class:`~pyrecover_tpu.analysis.concur.model.ConcurModel`), unlike
+jaxlint's per-module rules — a lock-order cycle or a two-root data race
+is only visible with every module on the table. Each rule returns
+:class:`~pyrecover_tpu.analysis.engine.Finding` objects; suppression
+resolution (the ``# concur: disable=...`` namespace) happens in
+:func:`analyze_modules` through the same engine machinery jaxlint uses.
+"""
+
+import dataclasses
+
+from pyrecover_tpu.analysis.engine import Finding, _load_modules, ModuleInfo
+from pyrecover_tpu.analysis.concur.model import (
+    ConcurModel,
+    DEFAULT_CONCUR_CONFIG,
+)
+
+CC_RULES = {}
+
+
+@dataclasses.dataclass
+class Rule:
+    id: str
+    name: str
+    severity: str
+    summary: str
+    check: object
+
+
+def rule(rule_id, name, severity, summary):
+    def deco(fn):
+        CC_RULES[name] = Rule(rule_id, name, severity, summary, fn)
+        return fn
+
+    return deco
+
+
+def finding(r, module, node, message):
+    return Finding(
+        rule=r.name, rule_id=r.id, severity=r.severity, path=module.relpath,
+        line=getattr(node, "lineno", 1),
+        col=getattr(node, "col_offset", 0) + 1, message=message,
+    )
+
+
+def _reachable_functions(model):
+    """Functions reachable from ANY root, with their root-name sets."""
+    return model.roots_of
+
+
+# ---- CC01: lock-order inversion ---------------------------------------------
+
+
+@rule(
+    "CC01", "lock-order-inversion", "error",
+    "two locks are acquired in opposite orders on paths run by different "
+    "roots — a deadlock waiting for the right interleaving",
+)
+def check_lock_order(model, config):
+    edges = {}  # (A, B) -> (module, node, roots)
+    for fn, roots in _reachable_functions(model).items():
+        facts = model.facts[fn]
+        for region in facts.regions:
+            for lock, node, order in facts.acquires:
+                if (
+                    lock != region.lock
+                    and order > region.order
+                    and region.start <= node.lineno <= region.end
+                ):
+                    key = (region.lock, lock)
+                    if key not in edges:
+                        edges[key] = (fn.module, node, set())
+                    edges[key][2].update(roots)
+            for call, target in facts.calls:
+                if target is None or not (
+                    region.start <= call.lineno <= region.end
+                ):
+                    continue
+                for lock, _via in model.acquires_closure(target):
+                    if lock == region.lock:
+                        continue
+                    key = (region.lock, lock)
+                    if key not in edges:
+                        edges[key] = (fn.module, call, set())
+                    edges[key][2].update(roots)
+    # cycle detection over the acquired-while-holding graph
+    adj = {}
+    for (a, b) in edges:
+        adj.setdefault(a, set()).add(b)
+    out = []
+    seen_cycles = set()
+    for start in sorted(adj):
+        # DFS from each lock looking for a path back to it
+        stack = [(start, (start,))]
+        while stack:
+            cur, path = stack.pop()
+            for nxt in sorted(adj.get(cur, ())):
+                if nxt == start and len(path) > 1:
+                    cycle = frozenset(path)
+                    if cycle in seen_cycles:
+                        continue
+                    seen_cycles.add(cycle)
+                    cycle_edges = list(zip(path, path[1:] + (start,)))
+                    roots = set()
+                    for e in cycle_edges:
+                        roots |= edges[e][2]
+                    if len(roots) < 2:
+                        continue  # one thread can't deadlock with itself
+                    module, node, _ = edges[cycle_edges[0]]
+                    sites = ", ".join(
+                        f"{a}->{b} at {edges[(a, b)][0].relpath}:"
+                        f"{edges[(a, b)][1].lineno}"
+                        for a, b in cycle_edges
+                    )
+                    out.append(finding(
+                        CC_RULES["lock-order-inversion"], module, node,
+                        f"lock-order inversion across roots "
+                        f"{sorted(roots)}: {' -> '.join(path + (start,))} "
+                        f"({sites}); pick one global order",
+                    ))
+                elif nxt not in path:
+                    stack.append((nxt, path + (nxt,)))
+    return out
+
+
+# ---- CC02: blocking work under a hot lock -----------------------------------
+
+
+@rule(
+    "CC02", "blocking-under-lock", "error",
+    "file I/O / fsync / sleep / subprocess / collective while holding a "
+    "lock the train loop can contend on — the PR 4 invariant 'blocking "
+    "actions never run under the engine lock', machine-checked",
+)
+def check_blocking_under_lock(model, config):
+    # locks the hot path can contend on: acquired anywhere in main reach
+    main = next(r for r in model.roots if r.kind == "main")
+    hot_locks = set()
+    for fn in main.reach:
+        for lock, _, _ in model.facts[fn].acquires:
+            hot_locks.add(lock)
+    out = []
+    seen = set()
+    for fn in sorted(
+        _reachable_functions(model), key=lambda f: f.qualname
+    ):
+        facts = model.facts[fn]
+        for region in facts.regions:
+            if region.lock not in hot_locks:
+                continue
+            for node, desc in facts.blocking + facts.collectives:
+                key = (fn.module.relpath, node.lineno, node.col_offset)
+                if key in seen or not (
+                    region.start <= node.lineno <= region.end
+                ):
+                    continue
+                seen.add(key)
+                out.append(finding(
+                    CC_RULES["blocking-under-lock"], fn.module, node,
+                    f"{desc} while holding {region.lock} (hot-path lock) "
+                    f"in {fn.qualname}; move the blocking work outside "
+                    "the held region",
+                ))
+            for call, target in facts.calls:
+                if target is None or not (
+                    region.start <= call.lineno <= region.end
+                ):
+                    continue
+                blocked = model.blocking_closure(target)
+                if not blocked:
+                    continue
+                key = (fn.module.relpath, call.lineno, call.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                desc, via = blocked[0]
+                out.append(finding(
+                    CC_RULES["blocking-under-lock"], fn.module, call,
+                    f"call to {target.qualname}() while holding "
+                    f"{region.lock} (hot-path lock) eventually runs "
+                    f"{desc} (via {via}); move the blocking work outside "
+                    "the held region",
+                ))
+    return out
+
+
+# ---- CC03: shared state mutated from several roots with no common lock ------
+
+
+@rule(
+    "CC03", "unguarded-shared-state", "error",
+    "a module global or instance attribute is mutated from two or more "
+    "roots with no common guarding lock (declare intent with "
+    "`# concur: guarded-by=<lock>` where the discipline is real but "
+    "invisible to the linear analysis)",
+)
+def check_unguarded_shared_state(model, config):
+    sites = {}  # shared id -> list of (module, node, roots, held)
+    for fn, roots in _reachable_functions(model).items():
+        facts = model.facts[fn]
+        for sid, node in facts.mutations:
+            held = facts.held_at(node) | model.marker_locks(
+                fn.module, fn, node
+            )
+            sites.setdefault(sid, []).append(
+                (fn.module, node, roots, held)
+            )
+    out = []
+    for sid in sorted(sites):
+        entries = sites[sid]
+        roots = set()
+        for _, _, r, _ in entries:
+            roots |= r
+        if len(roots) < 2:
+            continue
+        common = set.intersection(*(held for _, _, _, held in entries))
+        if common:
+            continue
+        entries.sort(key=lambda e: (e[0].relpath, e[1].lineno))
+        module, node, _, _ = entries[0]
+        others = ", ".join(
+            f"{m.relpath}:{n.lineno}" for m, n, _, _ in entries[1:4]
+        )
+        out.append(finding(
+            CC_RULES["unguarded-shared-state"], module, node,
+            f"'{sid}' is mutated from roots {sorted(roots)} with no "
+            f"common guarding lock"
+            + (f" (other sites: {others})" if others else "")
+            + "; hold one lock across every mutation or declare "
+            "`# concur: guarded-by=<lock>`",
+        ))
+    return out
+
+
+# ---- CC04: signal handlers touching locks / the telemetry bus ---------------
+
+
+@rule(
+    "CC04", "signal-unsafe-call", "error",
+    "a signal handler reaches a lock acquisition or emit() — handlers "
+    "run between bytecodes of the interrupted frame, which may already "
+    "hold that lock (self-deadlock)",
+)
+def check_signal_unsafe(model, config):
+    out = []
+    for root in model.roots:
+        if root.kind != "signal":
+            continue
+        offenders = []
+        for fn in sorted(root.reach, key=lambda f: f.qualname):
+            facts = model.facts[fn]
+            for lock, node, _ in facts.acquires:
+                offenders.append(
+                    (f"acquires {lock}", fn.module, node)
+                )
+            for node in facts.emits:
+                offenders.append(
+                    ("calls emit() (the bus serializes under an RLock)",
+                     fn.module, node)
+                )
+        if not offenders:
+            continue
+        entry = root.entries[0]
+        desc, omod, onode = offenders[0]
+        more = f" (+{len(offenders) - 1} more)" if len(offenders) > 1 else ""
+        out.append(finding(
+            CC_RULES["signal-unsafe-call"], entry.module, entry.node,
+            f"signal handler {entry.qualname} {desc} at "
+            f"{omod.relpath}:{onode.lineno}{more}; defer to a flag the "
+            "main loop polls, or justify why the interrupted frame can "
+            "never hold it",
+        ))
+    return out
+
+
+# ---- CC05: daemon threads owning durable writes, never joined ---------------
+
+
+@rule(
+    "CC05", "daemon-durable-io", "error",
+    "a daemon thread owns commit-path writes (fsync/rename) but is never "
+    "joined — interpreter exit tears the final save mid-write",
+)
+def check_daemon_durable(model, config):
+    out = []
+    for root in model.roots:
+        if root.kind != "thread" or not root.daemon:
+            continue
+        durable = model.durable_closure(root.entries[0])
+        if not durable:
+            continue
+        if model.thread_is_joined(root):
+            continue
+        desc, via = durable[0]
+        out.append(finding(
+            CC_RULES["daemon-durable-io"], root.module, root.node,
+            f"daemon thread {root.name} runs durable commit-path work "
+            f"({desc} via {via}) but no join() is wired to its handle — "
+            "interpreter exit can tear the write; join it on the unwind "
+            "(bounded timeout) or make the write non-durable",
+        ))
+    return out
+
+
+# ---- CC06: collectives dispatched off the registering thread ----------------
+
+
+@rule(
+    "CC06", "unpinned-collective", "error",
+    "a cross-host collective is reachable from a background root — "
+    "collectives must stay pinned to the calling (main) thread or hosts "
+    "deadlock waiting for ranks that never arrive",
+)
+def check_unpinned_collective(model, config):
+    out = []
+    seen = set()
+    for root in model.roots:
+        if root.kind == "main":
+            continue
+        for fn in sorted(root.reach, key=lambda f: f.qualname):
+            facts = model.facts[fn]
+            for node, desc in facts.collectives:
+                key = (fn.module.relpath, node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append(finding(
+                    CC_RULES["unpinned-collective"], fn.module, node,
+                    f"{desc} in {fn.qualname} is reachable from "
+                    f"{root.name} — zerostall's rule: collectives run on "
+                    "the calling thread ONLY; gather before handing off "
+                    "to the background",
+                ))
+    return out
+
+
+# ---- driver -----------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ConcurResult:
+    findings: list
+    files_scanned: int
+
+    @property
+    def unsuppressed(self):
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self):
+        return [f for f in self.findings if f.suppressed]
+
+
+def analyze_modules(modules, config=None, pre_findings=()):
+    """Run every enabled CC rule over parsed modules; suppressions are
+    resolved through each finding's own module (``concur:`` namespace)."""
+    config = config or DEFAULT_CONCUR_CONFIG
+    model = ConcurModel(modules, config)
+    by_path = {m.relpath: m for m in modules}
+    findings = list(pre_findings)
+    for r in CC_RULES.values():
+        if not config.rule_enabled(r.name, r.id):
+            continue
+        findings.extend(r.check(model, config))
+    for f in findings:
+        module = by_path.get(f.path)
+        if module is not None:
+            f.suppressed, f.justification = module.suppression_for(
+                f.rule, f.rule_id, f.line
+            )
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
+    return ConcurResult(
+        findings=findings, files_scanned=len(modules) + len(pre_findings)
+    )
+
+
+def analyze_paths(paths, config=None):
+    modules, pre = _load_modules(paths, tool="concur", error_id="CC00")
+    return analyze_modules(modules, config, pre_findings=pre)
+
+
+def analyze_source(source, name="<snippet>", config=None):
+    """Analyze one in-memory source string (the fixture-test entry point)."""
+    module = ModuleInfo(name, source, relpath=name, tool="concur")
+    return analyze_modules([module], config)

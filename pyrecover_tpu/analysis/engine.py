@@ -32,11 +32,40 @@ import re
 import tokenize
 from pathlib import Path
 
-_DIRECTIVE_RE = re.compile(
-    r"jaxlint:\s*(disable-next|disable-file|disable)\s*=\s*"
-    r"([A-Za-z0-9_\-, ]+?)\s*(?:--\s*(.*?)\s*)?$"
-)
-_MARKER_RE = re.compile(r"jaxlint:\s*(hot-loop|sync-point|host-only)\b")
+# The engine serves more than one analyzer: jaxlint (this package's
+# original tenant) and concur (analysis/concur — the concurrency-safety
+# analyzer) share the parsing, suppression, and marker machinery, each
+# under its own comment namespace (``# jaxlint: ...`` / ``# concur: ...``).
+# Directives (disable/disable-next/disable-file) are TOOL-SCOPED: a
+# ModuleInfo parses only its own tool's suppressions, so a jaxlint
+# suppression can never silence a concur finding or vice versa. Markers
+# are parsed for EVERY registered tool — concur's model consumes
+# jaxlint's ``hot-loop``/``host-only`` reachability markers, and jaxlint
+# simply ignores concur's ``guarded-by=<lock>`` declarations.
+_MARKERS_BY_TOOL = {
+    "jaxlint": r"hot-loop|sync-point|host-only",
+    "concur": r"guarded-by=[\w.\-]+",
+}
+
+_DIRECTIVE_RES = {}
+_MARKER_RES = {}
+
+
+def _directive_re(tool):
+    rx = _DIRECTIVE_RES.get(tool)
+    if rx is None:
+        rx = _DIRECTIVE_RES[tool] = re.compile(
+            rf"{tool}:\s*(disable-next|disable-file|disable)\s*=\s*"
+            r"([A-Za-z0-9_\-, ]+?)\s*(?:--\s*(.*?)\s*)?$"
+        )
+    return rx
+
+
+def _marker_res():
+    if not _MARKER_RES:
+        for tool, alts in _MARKERS_BY_TOOL.items():
+            _MARKER_RES[tool] = re.compile(rf"{tool}:\s*({alts})\b")
+    return _MARKER_RES.values()
 
 
 @dataclasses.dataclass
@@ -105,11 +134,19 @@ DEFAULT_CONFIG = LintConfig()
 
 
 class ModuleInfo:
-    """One parsed source file: AST, line table, suppressions, markers."""
+    """One parsed source file: AST, line table, suppressions, markers.
 
-    def __init__(self, path, source, relpath=None):
+    ``tool`` selects which comment namespace the suppression directives
+    are read from (``jaxlint`` by default; ``concur`` for the concurrency
+    analyzer). Markers from every registered tool are always parsed —
+    they carry cross-tool facts (reachability seeds, lock intent), not
+    suppressions.
+    """
+
+    def __init__(self, path, source, relpath=None, tool="jaxlint"):
         self.path = Path(path)
         self.relpath = str(relpath if relpath is not None else path)
+        self.tool = tool
         self.source = source
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=self.relpath)
@@ -146,8 +183,9 @@ class ModuleInfo:
                 (i + 1, line[line.index("#"):])
                 for i, line in enumerate(self.lines) if "#" in line
             ]
+        directive_re = _directive_re(self.tool)
         for lineno, text in comments:
-            m = _DIRECTIVE_RE.search(text)
+            m = directive_re.search(text)
             if m:
                 kind, raw_rules, just = m.group(1), m.group(2), m.group(3) or ""
                 rules = {r.strip() for r in raw_rules.split(",") if r.strip()}
@@ -159,9 +197,10 @@ class ModuleInfo:
                 else:  # disable-file
                     for r in rules:
                         self.suppress_file[r] = just
-            m = _MARKER_RE.search(text)
-            if m:
-                self.markers.setdefault(lineno, set()).add(m.group(1))
+            for marker_re in _marker_res():
+                m = marker_re.search(text)
+                if m:
+                    self.markers.setdefault(lineno, set()).add(m.group(1))
 
     def _next_code_line(self, lineno, justification):
         """A ``disable-next`` applies to the first CODE line after it —
@@ -172,7 +211,9 @@ class ModuleInfo:
             stripped = self.lines[t - 1].strip()
             if stripped and not stripped.startswith("#"):
                 break
-            if stripped.startswith("#") and not _DIRECTIVE_RE.search(stripped):
+            if stripped.startswith("#") and not _directive_re(
+                self.tool
+            ).search(stripped):
                 justification = (
                     justification + " " + stripped.lstrip("# ").strip()
                 ).strip()
@@ -260,14 +301,14 @@ def _iter_py_files(paths):
             yield p
 
 
-def _load_modules(paths):
+def _load_modules(paths, tool="jaxlint", error_id="JX00"):
     modules, findings = [], []
     for f in _iter_py_files(paths):
         try:
             source = f.read_text(encoding="utf-8")
         except (OSError, UnicodeDecodeError) as e:
             findings.append(Finding(
-                rule="unreadable-file", rule_id="JX00", severity="error",
+                rule="unreadable-file", rule_id=error_id, severity="error",
                 path=str(f), line=1, col=1, message=f"cannot read file: {e}",
             ))
             continue
@@ -276,10 +317,10 @@ def _load_modules(paths):
         except ValueError:
             rel = f
         try:
-            modules.append(ModuleInfo(f, source, relpath=rel))
+            modules.append(ModuleInfo(f, source, relpath=rel, tool=tool))
         except SyntaxError as e:
             findings.append(Finding(
-                rule="syntax-error", rule_id="JX00", severity="error",
+                rule="syntax-error", rule_id=error_id, severity="error",
                 path=str(rel), line=e.lineno or 1, col=(e.offset or 1),
                 message=f"syntax error: {e.msg}",
             ))

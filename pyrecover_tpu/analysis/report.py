@@ -52,10 +52,10 @@ def render_text(result, show_suppressed=False):
     return "\n".join(lines)
 
 
-def render_json(result, strict=False):
+def render_json(result, strict=False, tool="jaxlint"):
     return json.dumps(
         {
-            "tool": "jaxlint",
+            "tool": tool,
             "schema_version": JSON_SCHEMA_VERSION,
             "strict": bool(strict),
             "summary": summarize(result),

@@ -734,6 +734,111 @@ def check_pspec_axes(module, ctx):
     return out
 
 
+# ---- JX10: durable writes that skip the tmp+fsync+rename discipline ---------
+
+_WRITE_MODES = {"w", "wb", "w+", "wb+", "a", "ab", "a+", "ab+", "x", "xb"}
+_PATH_WRITE_ATTRS = {"write_text", "write_bytes"}
+_RENAME_DOTTED = {"os.replace", "os.rename"}
+_TMPISH = ("tmp", "temp")
+
+
+def _open_write_mode(call):
+    """The write mode of an ``open()`` call, else None (default mode is
+    read; ``os.fdopen`` is exempt — its fd came from ``tempfile``)."""
+    if dotted_name(call.func) != "open":
+        return None
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str) and \
+            mode.value in _WRITE_MODES:
+        return mode.value
+    return None
+
+
+def _mentions_tmp(expr):
+    """True when the write-target expression references a tmp-ish name or
+    literal — the staged half of the commit discipline."""
+    for node in ast.walk(expr):
+        text = None
+        if isinstance(node, ast.Name):
+            text = node.id
+        elif isinstance(node, ast.Attribute):
+            text = node.attr
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            text = node.value
+        if text is not None and any(t in text.lower() for t in _TMPISH):
+            return True
+    return False
+
+
+@rule(
+    "JX10", "torn-write", "error",
+    "a durable-path write skips the tmp+fsync+atomic-rename commit "
+    "discipline — a crash mid-write (or mid-publish, without fsync) "
+    "leaves a torn file the next resume half-trusts",
+)
+def check_torn_write(module, ctx):
+    out = []
+    r = RULES["torn-write"]
+    for fn in _module_functions(module, ctx):
+        writes = []  # (node, target expr, desc)
+        renames = []
+        for call in _calls_in(module, fn.node, fn.node):
+            d = dotted_name(call.func)
+            mode = _open_write_mode(call)
+            if mode is not None and call.args:
+                writes.append((call, call.args[0], f"open(..., '{mode}')"))
+            elif isinstance(call.func, ast.Attribute) and \
+                    call.func.attr in _PATH_WRITE_ATTRS:
+                writes.append(
+                    (call, call.func.value, f".{call.func.attr}()")
+                )
+            if d in _RENAME_DOTTED:
+                renames.append((call, d))
+        # durability may live in a sibling nested def of the same commit
+        # routine (the vanilla writer's _fsync_once/_rename_once split) —
+        # judge fsync presence over the OUTERMOST enclosing function
+        outer = fn
+        while outer.parent is not None:
+            outer = outer.parent
+        has_fsync = any(
+            isinstance(c, ast.Call) and (
+                dotted_name(c.func) == "os.fsync"
+                or (isinstance(c.func, ast.Attribute)
+                    and c.func.attr == "fsync")
+            )
+            for c in ast.walk(outer.node)
+        )
+        if renames:
+            # the function IS a commit site: the rename must be preceded
+            # by durability, or a power cut after the publish leaves the
+            # final name pointing at unsynced pages
+            if not has_fsync:
+                for call, d in renames:
+                    out.append(finding(
+                        r, module, call,
+                        f"{d}() publishes without an fsync in the same "
+                        "commit path — flush+fsync the staged file (and "
+                        "ideally the directory) before the atomic rename",
+                    ))
+            continue  # staged writes belong to the discipline
+        for call, target, desc in writes:
+            if _mentions_tmp(target):
+                continue  # writing the staged half; publish is elsewhere
+            out.append(finding(
+                r, module, call,
+                f"{desc} writes a durable path in place — a crash "
+                "mid-write leaves a torn file; stage to a tmp sibling, "
+                "fsync, then os.replace (or annotate the deliberately "
+                "tear-tolerant site)",
+            ))
+    return out
+
+
 # ---- JX08: legacy jax spellings that bypass utils/compat.py -----------------
 
 _LEGACY_MODULES = {

@@ -292,6 +292,9 @@ def main(argv=None):
         ))
 
     if args.json:
+        # jaxlint: disable-next=torn-write -- CI report artifact, regenerated
+        # every run; a torn report fails its consumer loudly and is simply
+        # re-produced
         Path(args.json).write_text(
             render_json(reports, strict=args.strict) + "\n", encoding="utf-8"
         )
@@ -347,6 +350,9 @@ def _diff_mode(args, preset_name, config):
         "memory": None, "census": None,
     }]
     if args.json:
+        # jaxlint: disable-next=torn-write -- CI report artifact, regenerated
+        # every run; a torn report fails its consumer loudly and is simply
+        # re-produced
         Path(args.json).write_text(
             render_json(reports, strict=args.strict) + "\n", encoding="utf-8"
         )

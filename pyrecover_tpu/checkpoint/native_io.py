@@ -42,6 +42,9 @@ def _load():
         _tried = True
         try:
             if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
+                # concur: disable-next=blocking-under-lock -- one-time lazy
+                # g++ build, guarded by exactly this lock to prevent a
+                # double compile; it completes before the first save can
                 _build()
             lib = ctypes.CDLL(str(_SO))
             lib.pr_xxh64.restype = ctypes.c_uint64

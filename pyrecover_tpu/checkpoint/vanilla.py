@@ -184,9 +184,19 @@ class VanillaSaveHandle:
         # goodput ledger's ckpt_shadow_s feed (0 for synchronous saves)
         self.shadow_s = 0.0
 
-    def wait(self):
+    def wait(self, timeout=None):
+        """Join the writer (bounded when ``timeout`` is given — the
+        train() unwind must not hang forever behind a wedged disk) and
+        re-raise any writer error. A timeout raises ``TimeoutError``
+        with the thread still running: the caller decides whether that
+        fails the run or just gets logged on an already-failing unwind."""
         if self._thread is not None:
-            self._thread.join()
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise TimeoutError(
+                    f"background checkpoint writer still running after "
+                    f"{timeout:.0f}s"
+                )
             self._thread = None
         if self.error is not None:
             raise self.error
@@ -403,6 +413,9 @@ def _write_stream(path, leaves_iter, meta, verify, max_keep):
         with telemetry.span(
             "ckpt_sidecar", engine="vanilla", metric="ckpt_vanilla_sidecar_s"
         ):
+            # jaxlint: disable-next=torn-write -- the sidecar is advisory
+            # integrity metadata: a torn sidecar FAILS verification and the
+            # resume falls back/quarantines — it can never be half-trusted
             io_retry(
                 lambda: _sidecar(path).write_text(checksum.result()),
                 op="sidecar", path=path_s,
@@ -679,34 +692,46 @@ def load_ckpt_vanilla(path, target_state, *, verify=False):
         verify_thread = threading.Thread(target=_verify, daemon=True)
         verify_thread.start()
 
-    with telemetry.span(
-        "ckpt_read", engine="vanilla", path=str(path),
-        metric="ckpt_vanilla_read_s",
-    ):
-        meta, _, np_leaves = read_ckpt_raw(path)
+    # the verify thread is joined on EVERY exit path: a decode error below
+    # must not leak a thread still checksumming a (possibly corrupt) file —
+    # the latest-resume fallback would pile one leaked reader per rejected
+    # candidate (the CC05 leak class concur guards against)
+    try:
+        with telemetry.span(
+            "ckpt_read", engine="vanilla", path=str(path),
+            metric="ckpt_vanilla_read_s",
+        ):
+            meta, _, np_leaves = read_ckpt_raw(path)
 
-    leaves, treedef = jax.tree_util.tree_flatten(target_state)
-    if meta["num_leaves"] != len(leaves):
-        raise CheckpointStructureError(
-            f"Checkpoint has {meta['num_leaves']} leaves, target expects {len(leaves)}"
-        )
+        leaves, treedef = jax.tree_util.tree_flatten(target_state)
+        if meta["num_leaves"] != len(leaves):
+            raise CheckpointStructureError(
+                f"Checkpoint has {meta['num_leaves']} leaves, target expects {len(leaves)}"
+            )
 
-    with telemetry.span(
-        "ckpt_device_put", engine="vanilla",
-        metric="ckpt_vanilla_device_put_s",
-    ):
-        restored = []
-        for tgt, src in zip(leaves, np_leaves):
-            if tuple(tgt.shape) != tuple(src.shape):
-                raise CheckpointStructureError(
-                    f"Shape mismatch on restore: checkpoint {src.shape} vs target {tgt.shape}"
-                )
-            src = src.astype(tgt.dtype)
-            if isinstance(tgt, jax.Array) and hasattr(tgt, "sharding"):
-                restored.append(jax.device_put(src, tgt.sharding))
-            else:
-                restored.append(jax.numpy.asarray(src))
-        state = jax.tree_util.tree_unflatten(treedef, restored)
+        with telemetry.span(
+            "ckpt_device_put", engine="vanilla",
+            metric="ckpt_vanilla_device_put_s",
+        ):
+            restored = []
+            for tgt, src in zip(leaves, np_leaves):
+                if tuple(tgt.shape) != tuple(src.shape):
+                    raise CheckpointStructureError(
+                        f"Shape mismatch on restore: checkpoint {src.shape} vs target {tgt.shape}"
+                    )
+                src = src.astype(tgt.dtype)
+                if isinstance(tgt, jax.Array) and hasattr(tgt, "sharding"):
+                    restored.append(jax.device_put(src, tgt.sharding))
+                else:
+                    restored.append(jax.numpy.asarray(src))
+            state = jax.tree_util.tree_unflatten(treedef, restored)
+    except BaseException:
+        if verify_thread is not None:
+            # bounded: the checksum pass is finite (it reads the same
+            # file), but a wedged disk must not turn a corrupt-checkpoint
+            # fallback into a hang
+            verify_thread.join(timeout=600)
+        raise
 
     if verify_thread is not None:
         with telemetry.span(

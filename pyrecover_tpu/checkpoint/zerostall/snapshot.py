@@ -64,9 +64,18 @@ class ZerostallSaveHandle:
         self.shadow_s = 0.0
         self.manifest_path = None
 
-    def wait(self):
+    def wait(self, timeout=None):
+        """Join the writer (bounded when ``timeout`` is given) and
+        re-raise any writer error; on timeout raises ``TimeoutError``
+        with the daemon thread still running — the caller owns the
+        policy (the train() unwind logs it, a mid-run backpressure wait
+        passes no timeout and blocks until the commit)."""
         if self._thread is not None:
-            self._thread.join()
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise TimeoutError(
+                    f"zerostall writer still running after {timeout:.0f}s"
+                )
             self._thread = None
         if self.error is not None:
             raise self.error

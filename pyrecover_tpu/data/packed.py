@@ -118,9 +118,16 @@ class PackedParquetTextDataset:
             try:
                 tmp_s = stream_path.with_suffix(".tmp.npy")
                 np.save(tmp_s, stream)
+                # jaxlint: disable-next=torn-write -- cache pair is
+                # self-validating (dtype/shape gate above rejects a torn
+                # stream and triggers a rebuild); fsyncing a multi-GB
+                # token stream would stall every cold start for a file
+                # that is derivable from the corpus
                 os.replace(tmp_s, stream_path)
                 tmp = sidecar.with_suffix(".tmp.npz")
                 np.savez(tmp, key=np.str_(key), lengths=lengths)
+                # jaxlint: disable-next=torn-write -- same self-validating
+                # cache protocol as the stream publish above
                 os.replace(tmp, sidecar)
                 # persisted: swap the resident concatenation for the memmap
                 # (a multi-GB corpus must not stay in host RAM for the

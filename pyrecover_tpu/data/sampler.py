@@ -12,6 +12,8 @@ epoch boundary advances the permutation and immediately yields a fresh
 batch; no batch is ever trained twice.
 """
 
+# concur: disable-file=unguarded-shared-state -- single-consumer by protocol: only the loader's producer thread calls next_batch() after start(), and every main-thread mutation (seek/load_state_dict on resume) happens strictly before DataLoader.start() spawns it (Thread.start() is the happens-before edge); a lock here would serialize the hottest host-side path for a race the lifecycle already excludes
+
 import numpy as np
 
 

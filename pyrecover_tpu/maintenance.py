@@ -149,6 +149,9 @@ class MaintenanceEventWatcher:
         if self.notice_file is not None:
             try:
                 self.notice_file.parent.mkdir(parents=True, exist_ok=True)
+                # jaxlint: disable-next=torn-write -- advisory notice file:
+                # the consumers (launcher, preempt watcher) only test
+                # existence; content is best-effort
                 self.notice_file.write_text(description)
             except OSError as e:
                 log_host0("could not write notice file %s: %s",

@@ -57,6 +57,9 @@ class LossCSVLogger:
                             kept.append(r)
                     except ValueError:
                         continue
+                # jaxlint: disable-next=torn-write -- resume-time rewrite
+                # keeps only rows <= resume_step; a tear costs log rows,
+                # never training state, and the next resume re-truncates
                 with open(path, "w", newline="") as f:
                     csv.writer(f).writerows(kept)
             self._file = open(path, "a" if append else "w", newline="")

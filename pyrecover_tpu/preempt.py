@@ -138,6 +138,11 @@ class PreemptionWatcher:
         if self._handler_installed:
             return self
 
+        # concur: disable-next=signal-unsafe-call -- the emit/dump path runs
+        # only on the SECOND signal while a deferred-exit save is armed,
+        # and it is terminal: os._exit(75) follows immediately, so a
+        # deadlocked bus lock costs nothing the scheduler's SIGKILL was
+        # not already about to take; the first signal only flips flags
         def handler(signum, frame):
             self.signal_count += 1
             self._signal_seen = True
@@ -326,6 +331,9 @@ def write_requeue_marker(exp_dir, *, done=False, step=None):
     payload = {"ts": time.time(), "done": bool(done)}
     if step is not None:
         payload["step"] = int(step)
+    # jaxlint: disable-next=torn-write -- markers are advisory:
+    # read_requeue_marker explicitly tolerates torn/garbage content
+    # (documented legacy/garbage fallbacks)
     marker.write_text(json.dumps(payload))
 
 

@@ -76,7 +76,7 @@ class _Fault:
         self.hits = 0
         self.fired = 0
 
-    def maybe_fire(self, engine, site, ctx):
+    def maybe_fire(self, engine, site, ctx):  # concur: guarded-by=FaultEngine._lock
         with engine._lock:
             self.hits += 1
             if not self.should_fire(engine, site, ctx):

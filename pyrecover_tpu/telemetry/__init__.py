@@ -29,6 +29,11 @@ Core event names across the stack (fields beyond the envelope):
     ckpt_backpressure engine, path, wait_s (a save arrived while the
                       previous zerostall save was still in flight; the
                       depth-1 queue made it wait, loudly)
+    ckpt_bg_join      engine, waited_s, completed, ok, bounded (a pending
+                      background save handle was joined — mid-run before
+                      the next save, and with a bounded timeout on
+                      train()'s unwind, so no non-daemon checkpoint work
+                      is ever abandoned at exit)
     ckpt_gc           engine, removed, removed_bytes, kept, seconds
                       (refcounted chunk GC collected orphans; a chunk any
                       live manifest references is never collected)

@@ -37,6 +37,9 @@ def _process_index():
     try:
         import jax
 
+        # concur: disable-next=unguarded-shared-state -- benign race: an
+        # idempotent cache fill with an immutable int; racing writers all
+        # store the same value, and the GIL makes the store atomic
         _host = jax.process_index()
         return _host
     except Exception:

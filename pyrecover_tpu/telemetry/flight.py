@@ -170,6 +170,9 @@ class FlightRecorder:
                 # had faulthandler armed, so uninstall can hand it back
                 self._prev_faulthandler = faulthandler.is_enabled()
                 self.postmortem_dir.mkdir(parents=True, exist_ok=True)
+                # jaxlint: disable-next=torn-write -- faulthandler needs one
+                # always-open real fd; the file is evidence only when
+                # non-empty and uninstall prunes empty ones
                 self._fatal_file = open(self._fatal_path(), "w")
                 faulthandler.enable(file=self._fatal_file, all_threads=True)
             except Exception:
@@ -290,6 +293,9 @@ class FlightRecorder:
             })
             with open(tmp / "stacks.txt", "w") as f:
                 f.write(_format_all_stacks())
+            # jaxlint: disable-next=torn-write -- best-effort postmortem:
+            # fsyncing the whole staged tree mid-crash costs more than a lost
+            # bundle; doctor tolerates absence
             os.replace(tmp, final)
         except OSError:
             try:
@@ -305,6 +311,8 @@ class FlightRecorder:
 
 
 def _write_json(path, obj):
+    # jaxlint: disable-next=torn-write -- writes only inside the staged .tmp_
+    # bundle dir; dump() publishes the whole dir with one os.replace
     with open(path, "w") as f:
         json.dump(obj, f, indent=2, default=str)
 

@@ -50,6 +50,12 @@ from pyrecover_tpu.train_state import (
 from pyrecover_tpu.utils.logging import init_logger, log_host0
 from pyrecover_tpu.utils.perf import get_num_params
 
+# upper bound on how long train()'s unwind waits for an in-flight
+# background checkpoint writer before declaring it wedged (TimeoutError →
+# logged on an already-failing unwind, raised otherwise). Generous: a
+# healthy writer finishes in seconds; only a dead disk reaches this.
+_BG_JOIN_TIMEOUT_S = 600.0
+
 
 def state_pspecs(abstract_state):
     """PartitionSpecs for the FULL train state. Optimizer moments mirror the
@@ -721,13 +727,31 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
     engine = config.checkpoint_engine
     pending_saves = []  # at most one in-flight background save handle
 
-    def join_pending_saves():
+    def join_pending_saves(timeout_s=None):
+        """Join every in-flight background save handle. Mid-run callers
+        pass no timeout (the next save must serialize behind the previous
+        commit); the train() unwind passes a bounded one so a wedged disk
+        cannot turn teardown into a hang. Every join emits a
+        ``ckpt_bg_join`` event — the regression trail proving no
+        non-daemon checkpoint work is abandoned at exit."""
         while pending_saves:
             handle = pending_saves.pop()
-            handle.wait()
-            # background seconds the train loop did NOT pay for: the
-            # goodput ledger's recovered-overlap bucket
-            totals.ckpt_shadow_s += getattr(handle, "shadow_s", 0.0) or 0.0
+            t0 = time.monotonic()
+            try:
+                handle.wait(timeout=timeout_s)
+            finally:
+                telemetry.emit(
+                    "ckpt_bg_join", engine=engine,
+                    waited_s=round(time.monotonic() - t0, 4),
+                    completed=bool(handle.done),
+                    ok=handle.error is None,
+                    bounded=timeout_s is not None,
+                )
+                # background seconds the train loop did NOT pay for: the
+                # goodput ledger's recovered-overlap bucket
+                totals.ckpt_shadow_s += (
+                    getattr(handle, "shadow_s", 0.0) or 0.0
+                )
 
     def save_ckpt(step, final=False):
         path = checkpoint_path(
@@ -1165,7 +1189,11 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
                 pending_losses.clear()
             csv_logger.close()
         try:
-            join_pending_saves()  # a failed background save must fail the run
+            # a failed background save must fail the run; the bounded
+            # timeout keeps a wedged writer from hanging the unwind (the
+            # daemon flag would then be what it was always meant to be:
+            # the very last resort, after a loud TimeoutError)
+            join_pending_saves(timeout_s=_BG_JOIN_TIMEOUT_S)
         except Exception:
             if not unwinding:
                 raise

@@ -200,6 +200,27 @@ def spec():
     return P("data", ("fsdp", "tensor"), None)
 """,
     ),
+    "torn-write": (
+        """
+import json
+
+def publish(path, doc):
+    with open(path, "w") as f:
+        json.dump(doc, f)
+""",
+        """
+import json
+import os
+
+def publish(path, doc):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+""",
+    ),
 }
 
 

@@ -7,6 +7,9 @@ gates resume before any tensor read; the CLI keeps the jaxlint exit-code
 and JSON contracts."""
 
 import json
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
 
 import jax
 import jax.numpy as jnp
@@ -320,9 +323,20 @@ def test_sharded_precheck_uses_manifest(tmp_path):
 
 
 def test_check_catalog_complete():
+    """SC ids are exactly 1..11, unique, and every one is documented in
+    the README (id AND kebab-case name appear) — the PR 7 catalog drift
+    (SC11 landing without its README row) can't recur silently."""
     assert set(CHECKS) == {f"SC{i:02d}" for i in range(1, 12)}
     names = [v[0] for v in CHECKS.values()]
     assert len(names) == len(set(names))
+    readme = (REPO / "README.md").read_text(encoding="utf-8")
+    undocumented = [
+        f"{cid} ({name})" for cid, (name, _, _) in CHECKS.items()
+        if cid not in readme or name not in readme
+    ]
+    assert undocumented == [], (
+        f"README.md is missing shardcheck catalog entries: {undocumented}"
+    )
 
 
 def test_check_preset_report_shape():

@@ -79,6 +79,8 @@ def build_corpus(root, n_docs, mean_words, seed=0):
         tokenizer_object=tok, pad_token="[PAD]", unk_token="[UNK]",
         eos_token="[EOS]",
     ).save_pretrained(tok_dir)
+    # jaxlint: disable-next=torn-write -- the marker IS the commit protocol:
+    # presence-only, written LAST; a torn marker only forces a rebuild
     done.write_text("ok")  # marker LAST: its presence == complete build
     return corpus, tok_dir
 

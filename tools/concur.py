@@ -1,0 +1,25 @@
+#!/usr/bin/env python
+"""concur CLI — static concurrency-safety analysis with a CI gate.
+
+Usage:
+    python tools/concur.py pyrecover_tpu/ --strict
+    python tools/concur.py --list-rules
+    python tools/concur.py pyrecover_tpu/ --json /tmp/concur.json
+
+All logic lives in ``pyrecover_tpu.analysis.concur`` (thread-root/lock
+model in ``model.py``, rules CC01–CC06 in ``rules.py``, suppression
+syntax shared with jaxlint under the ``concur:`` comment namespace);
+this file is the executable shim so the analyzer is runnable before the
+package is installed.
+"""
+
+import sys
+from pathlib import Path
+
+# runnable from any cwd, installed or not
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from pyrecover_tpu.analysis.concur.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
