@@ -69,6 +69,23 @@ else
   rc=1
 fi
 
+# shardcheck bandwidth-lean gate: the zero1 + int8 update path must stay
+# wired end to end — the same 1/2/4/8-device mesh matrix with
+# --optimizer-sharding zero1 --grad-allreduce int8 re-resolves the state
+# specs per mesh (data-sharded moments, the int8 error-feedback residual),
+# traces the census (SC12 fires if the quantized sync collective ever
+# drops out of the step, or if zero1 stops sharding anything), and prices
+# the wire traffic against the fp32/none baseline in the JSON report.
+if SHARDCHECK_Z1_OUT=$(JAX_PLATFORMS=cpu python tools/shardcheck.py \
+    --preset llama-150m --strict \
+    --optimizer-sharding zero1 --grad-allreduce int8 \
+    --json "${SHARDCHECK_Z1_JSON:-/tmp/shardcheck_zero1_report.json}" 2>&1); then
+  echo "$SHARDCHECK_Z1_OUT" | tail -2   # clean: wire summary + count line
+else
+  echo "$SHARDCHECK_Z1_OUT"
+  rc=1
+fi
+
 # chaos smoke: the recovery stack's soak gate (pyrecover_tpu/resilience).
 # Runs the real tiny-model trainer on CPU under a seeded fault plan —
 # SIGTERM drill, SIGKILL mid-save, transient EIO under the writer, flipped

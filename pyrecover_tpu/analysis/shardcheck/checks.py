@@ -43,6 +43,11 @@ CHECKS = {
              "an elastic-resume reshard plan cannot be expressed on the "
              "target mesh (indivisible leaf dim, unresolvable mesh, or a "
              "data pipeline that cannot rescale to the new replica count)"),
+    "SC12": ("full-precision-collective", "error",
+             "the bandwidth-lean update path is configured (zero1 / "
+             "quantized gradient collectives) but the traced step or the "
+             "resolved specs still move/hold full-precision replicated "
+             "state — the configuration is not actually wired in"),
 }
 
 

@@ -63,6 +63,24 @@ def _build_parser():
         help="skip the (train-step tracing) collective census",
     )
     p.add_argument(
+        "--optimizer-sharding", default="none", choices=("none", "zero1"),
+        help="check the bandwidth-lean update path: zero1 shards AdamW "
+        "moments over the data axis (specs, HBM table and census all "
+        "reflect it; SC12 fires when nothing actually shards)",
+    )
+    p.add_argument(
+        "--grad-allreduce", default="fp32", choices=("fp32", "bf16", "int8"),
+        help="gradient-sync wire format to check: the census traces the "
+        "step built in this mode (SC12 fires when the quantized "
+        "collective is configured but absent from the trace) and the "
+        "traffic model prices the wire against the fp32/none baseline",
+    )
+    p.add_argument(
+        "--grad-quant-block", type=int, default=256,
+        help="int8 quantization block size for the traffic model and the "
+        "traced step (default 256)",
+    )
+    p.add_argument(
         "--diff-checkpoint", metavar="PATH", default=None,
         help="diff a saved checkpoint's schema manifest against the "
         "(single) --preset instead of running the mesh matrix",
@@ -167,6 +185,19 @@ def render_text(reports):
             ]
             if parts:
                 lines.append("  modelled/step: " + " | ".join(parts))
+        traffic = r.get("traffic")
+        if traffic and traffic["configured"]["mode"] != "fp32/none":
+            cfg_t = traffic["configured"]
+            legs = ", ".join(
+                f"{k} {_human(v)}" for k, v in cfg_t["legs_bytes"].items()
+            )
+            lines.append(
+                f"  wire/step ({traffic['data_replicas']} data replicas): "
+                f"{cfg_t['mode']} {_human(cfg_t['bytes_on_wire_per_step'])}"
+                f" [{legs}] vs fp32/none "
+                f"{_human(traffic['baseline']['bytes_on_wire_per_step'])}"
+                f" ({traffic['reduction_pct']:+.1f}% saved)"
+            )
         for f in r["findings"]:
             lines.append("  " + _finding_line(f))
         total += len(r["findings"])
@@ -289,6 +320,9 @@ def main(argv=None):
             config=config, batch_size=args.batch_size, seq_len=args.seq_len,
             run_census=not args.no_census,
             mesh_configs=[explicit] if explicit is not None else None,
+            optimizer_sharding=args.optimizer_sharding,
+            grad_allreduce=args.grad_allreduce,
+            quant_block=args.grad_quant_block,
         ))
 
     if args.json:

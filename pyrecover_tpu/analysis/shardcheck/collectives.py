@@ -17,6 +17,7 @@ traced counts and labelled as modelled, not observed.
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from pyrecover_tpu.analysis.shardcheck.checks import (
     leaf_nbytes,
@@ -42,31 +43,53 @@ def _iter_sub_jaxprs(params):
                 yield cand.jaxpr
 
 
-def count_prims(jaxpr, counts=None, mult=1, gathers=None):
+def count_prims(jaxpr, counts=None, mult=1, gathers=None, wire_dtypes=None):
     """Recursive primitive census. Scan multiplies by its trip count, so
     a per-layer collective inside the layer scan counts n_layers times.
     ``gathers`` collects (shape, nbytes) of all_gather outputs for the
-    full-param-gather check."""
+    full-param-gather check; ``wire_dtypes`` collects the output dtype
+    strings of every all_to_all/all_gather — the quantized-sync evidence
+    the SC12 wiring check reads (an int8 gradient sync shows int8
+    payloads on the exchange primitives)."""
     counts = {} if counts is None else counts
     for eqn in jaxpr.eqns:
         name = eqn.primitive.name
         counts[name] = counts.get(name, 0) + mult
-        if gathers is not None and name == "all_gather":
+        if name in ("all_gather", "all_to_all"):
             for var in eqn.outvars:
                 aval = getattr(var, "aval", None)
-                if aval is not None and hasattr(aval, "shape"):
+                if aval is None or not hasattr(aval, "shape"):
+                    continue
+                if gathers is not None and name == "all_gather":
                     gathers.append(tuple(aval.shape))
+                if wire_dtypes is not None:
+                    wire_dtypes.append(str(aval.dtype))
         sub_mult = mult
         if name == "scan":
             sub_mult = mult * int(eqn.params.get("length", 1))
         for sub in _iter_sub_jaxprs(eqn.params):
-            count_prims(sub, counts, sub_mult, gathers)
+            count_prims(sub, counts, sub_mult, gathers, wire_dtypes)
     return counts
+
+
+QUANT_WIRE_DTYPE = {"int8": "int8", "bf16": "bfloat16"}
+
+
+def quantized_sync_missing(wire_dtypes, grad_allreduce, data_axis_size):
+    """True when a quantized gradient sync was CONFIGURED but the traced
+    step shows no exchange primitive carrying the quantized payload —
+    the SC12 condition. Only judged when the data axis actually exists
+    (at size 1 the sync is local math; nothing should be on the wire)."""
+    if grad_allreduce not in QUANT_WIRE_DTYPE or data_axis_size <= 1:
+        return False
+    return QUANT_WIRE_DTYPE[grad_allreduce] not in set(wire_dtypes or ())
 
 
 def census(model_config, optimizer, batch_size, seq_len, *, mesh=None,
            loss_chunk_size=0, config=None, locus="config",
-           param_leaves=None, param_specs=None):
+           param_leaves=None, param_specs=None,
+           optimizer_sharding="none", grad_allreduce="fp32",
+           quant_block=256):
     """Trace one train step abstractly and return ``(table, findings)``.
 
     ``mesh``: a concrete Mesh to trace under (activates the sharding
@@ -74,6 +97,12 @@ def census(model_config, optimizer, batch_size, seq_len, *, mesh=None,
     mesh-free (constraints no-op — counts still cover the collective-free
     structure). ``param_leaves``/``param_specs`` (the spec-check inputs)
     feed the full-param-gather scan and the analytic model.
+
+    ``optimizer_sharding``/``grad_allreduce`` build the step in the
+    bandwidth-lean configuration: the traced jaxpr then shows the
+    EXPLICIT quantized sync collectives (int8/bf16 ``all_to_all`` +
+    ``all_gather``), and their ABSENCE when configured is the SC12
+    wiring failure.
     """
     from pyrecover_tpu.analysis.shardcheck.checks import DEFAULT_CONFIG
     from pyrecover_tpu.config import TrainConfig
@@ -83,20 +112,34 @@ def census(model_config, optimizer, batch_size, seq_len, *, mesh=None,
     if optimizer is None:
         from pyrecover_tpu.optim import build_optimizer
 
-        optimizer, _ = build_optimizer(TrainConfig())
+        optimizer, _ = build_optimizer(
+            TrainConfig(optimizer_sharding=optimizer_sharding)
+        )
+    mesh_shape = (
+        {str(k): int(v) for k, v in dict(mesh.shape).items()}
+        if mesh is not None else {}
+    )
+    data_n = int(mesh_shape.get("data", 1))
+    residual_replicas = data_n if grad_allreduce == "int8" else 0
     abstract = jax.eval_shape(
-        lambda key: create_train_state(key, model_config, optimizer),
+        lambda key: create_train_state(
+            key, model_config, optimizer,
+            grad_residual_replicas=residual_replicas,
+            grad_quant_block=quant_block,
+        ),
         jax.random.key(0),
     )
     step_fn = make_train_step(
         model_config, optimizer, donate=False,
         loss_chunk_size=loss_chunk_size,
+        optimizer_sharding=optimizer_sharding,
+        grad_allreduce=grad_allreduce, grad_quant_block=quant_block,
     )
     batch = {
         "inputs": jax.ShapeDtypeStruct((batch_size, seq_len), jnp.int32),
         "labels": jax.ShapeDtypeStruct((batch_size, seq_len), jnp.int32),
     }
-    counts, gathers = {}, []
+    counts, gathers, wire_dtypes = {}, [], []
     try:
         if mesh is not None:
             with jax.sharding.set_mesh(mesh):
@@ -116,7 +159,7 @@ def census(model_config, optimizer, batch_size, seq_len, *, mesh=None,
                 f"{batch_size}, seq={seq_len}: {e}",
             )],
         )
-    count_prims(jaxpr.jaxpr, counts, 1, gathers)
+    count_prims(jaxpr.jaxpr, counts, 1, gathers, wire_dtypes)
 
     table = {
         "traced": {
@@ -125,8 +168,19 @@ def census(model_config, optimizer, batch_size, seq_len, *, mesh=None,
             or "all_gather" in k or "psum" in k
         },
         "mesh_context": mesh is not None,
+        "wire_dtypes": sorted(set(wire_dtypes)),
     }
     findings = []
+    if quantized_sync_missing(wire_dtypes, grad_allreduce, data_n) and (
+        config.check_enabled("SC12")
+    ):
+        findings.append(make_finding(
+            "SC12", locus,
+            f"--grad-allreduce {grad_allreduce} is configured but the "
+            f"traced step shows no {QUANT_WIRE_DTYPE[grad_allreduce]} "
+            "exchange collective — gradients would still move at full "
+            "precision",
+        ))
     if param_leaves is not None:
         big = {
             tuple(shape): path for path, shape, dtype in param_leaves
@@ -174,3 +228,78 @@ def analytic_collectives(param_leaves, param_specs, mesh_shape):
         out["fsdp_grad_reduce_scatter_bytes"] = fsdp_bytes
     out["sharded_param_bytes_by_axis"] = per_axis
     return out
+
+
+def traffic_model(param_leaves, mesh_shape, *, grad_allreduce="fp32",
+                  optimizer_sharding="none", quant_block=256,
+                  grad_clipping=True):
+    """Per-step bytes-on-wire for the data-axis gradient sync: the
+    CONFIGURED bandwidth-lean path vs the fp32/none baseline.
+
+    Ring-collective accounting per replica: one reduce-scatter or
+    allgather leg moves ``(n-1)/n × payload`` bytes, an allreduce is two
+    legs. Payloads follow the implementation exactly
+    (parallel/collectives.py + optim.zero1_wrap):
+
+    * fp32          — 2 legs × grad bytes (the implicit GSPMD allreduce).
+    * bf16/int8     — 2 legs × quantized payload (int8 pays a f32 scale
+                      per ``quant_block`` elements).
+    * zero1 (+fp32) — with global-norm clipping the gradients are
+                      materialized replicated FIRST (the bit-exactness
+                      anchor), so the allreduce stays, plus one allgather
+                      leg for the updates; without clipping the sync
+                      lowers to reduce-scatter + update allgather — the
+                      baseline's exact byte count.
+    * zero1 (+quant)— quantized sync legs + the fp32 update allgather.
+
+    The zero1 win is measured in the memory table (optimizer bytes ÷
+    data-axis size), not here; this model keeps the wire ledger honest
+    about that trade.
+    """
+    n = int(mesh_shape.get("data", 1))
+    elems = 0
+    grad_bytes = 0
+    for _, shape, dtype in param_leaves:
+        count = int(np.prod(shape, dtype=np.int64)) if len(shape) else 1
+        elems += count
+        grad_bytes += count * np.dtype(dtype).itemsize
+
+    def leg(payload_bytes):
+        return (n - 1) / n * payload_bytes if n > 1 else 0.0
+
+    from pyrecover_tpu.parallel.collectives import wire_bytes_per_element
+
+    bpe = wire_bytes_per_element(
+        grad_allreduce, quant_block, elem_bytes=grad_bytes / max(elems, 1)
+    )
+    legs = {}
+    if grad_allreduce == "fp32":
+        if optimizer_sharding == "zero1" and not grad_clipping:
+            legs["grad_reduce_scatter"] = leg(grad_bytes)
+        else:
+            legs["grad_allreduce"] = 2 * leg(grad_bytes)
+    else:
+        legs["quantized_reduce_scatter"] = leg(elems * bpe)
+        legs["quantized_allgather"] = leg(elems * bpe)
+    if optimizer_sharding == "zero1":
+        legs["update_allgather"] = leg(grad_bytes)
+    configured = int(round(sum(legs.values())))
+    baseline = int(round(2 * leg(grad_bytes)))
+    return {
+        "modelled": True,
+        "data_replicas": n,
+        "grad_bytes_fp32": grad_bytes,
+        "quant_block": int(quant_block) if grad_allreduce == "int8" else None,
+        "baseline": {
+            "mode": "fp32/none",
+            "bytes_on_wire_per_step": baseline,
+        },
+        "configured": {
+            "mode": f"{grad_allreduce}/{optimizer_sharding}",
+            "bytes_on_wire_per_step": configured,
+            "legs_bytes": {k: int(round(v)) for k, v in legs.items()},
+        },
+        "reduction_pct": (
+            round(100.0 * (1 - configured / baseline), 2) if baseline else 0.0
+        ),
+    }

@@ -21,13 +21,16 @@ from pyrecover_tpu.analysis.shardcheck.checks import (
 from pyrecover_tpu.analysis.shardcheck.collectives import (
     analytic_collectives,
     census,
+    traffic_model,
 )
-from pyrecover_tpu.parallel.mesh import MESH_AXES, MeshConfig
+from pyrecover_tpu.parallel.mesh import AXIS_DATA, MESH_AXES, MeshConfig
 
 BATCH_LEAF = "<batch tokens>"
 
 
-def abstract_state_leaves(model_config, optimizer=None):
+def abstract_state_leaves(model_config, optimizer=None, *,
+                          optimizer_sharding="none", grad_allreduce="fp32",
+                          quant_block=256, mesh_shape=None):
     """``(leaves, specs)`` for the FULL train state, abstractly.
 
     ``leaves`` are ``(keystr path, shape, dtype)`` triples from
@@ -35,6 +38,12 @@ def abstract_state_leaves(model_config, optimizer=None):
     moments + counters — the optimizer moments mirror the param leaf
     names, so the same path rules shard them); ``specs`` is the aligned
     PartitionSpec list from ``train.state_pspecs``.
+
+    The bandwidth-lean modes change the state itself, per mesh: zero1
+    shards the moments over the data axis (divisibility decided against
+    ``mesh_shape``), and int8 gradient collectives add the per-replica
+    ``grad_residual`` leaf whose leading dim IS the data-axis size — so
+    callers checking those modes must resolve leaves per mesh shape.
     """
     from pyrecover_tpu.config import TrainConfig
     from pyrecover_tpu.optim import build_optimizer
@@ -42,12 +51,22 @@ def abstract_state_leaves(model_config, optimizer=None):
     from pyrecover_tpu.train_state import create_train_state
 
     if optimizer is None:
-        optimizer, _ = build_optimizer(TrainConfig())
+        optimizer, _ = build_optimizer(
+            TrainConfig(optimizer_sharding=optimizer_sharding)
+        )
+    residual_replicas = (
+        int((mesh_shape or {}).get("data", 1))
+        if grad_allreduce == "int8" else 0
+    )
     abstract = jax.eval_shape(
-        lambda key: create_train_state(key, model_config, optimizer),
+        lambda key: create_train_state(
+            key, model_config, optimizer,
+            grad_residual_replicas=residual_replicas,
+            grad_quant_block=quant_block,
+        ),
         jax.random.key(0),
     )
-    specs = state_pspecs(abstract)
+    specs = state_pspecs(abstract, optimizer_sharding, mesh_shape)
     path_leaves = jax.tree_util.tree_flatten_with_path(abstract)[0]
     leaves = [
         (jax.tree_util.keystr(p), tuple(x.shape), x.dtype)
@@ -135,13 +154,24 @@ def _param_only(leaves, specs):
 
 def check_preset(name, model_config, *, device_counts=(1, 2, 4, 8),
                  config=None, batch_size=None, seq_len=None,
-                 run_census=True, mesh_configs=None):
+                 run_census=True, mesh_configs=None,
+                 optimizer_sharding="none", grad_allreduce="fp32",
+                 quant_block=256):
     """Full preflight of one preset: spec matrix + memory + census.
 
     Returns a report dict (JSON-ready except the Finding objects under
     ``"findings"`` — the CLI serializes those).
+
+    ``optimizer_sharding``/``grad_allreduce`` check the bandwidth-lean
+    update path: state leaves + specs are re-resolved PER MESH (zero1
+    divisibility and the int8 residual's replica dim depend on the data
+    axis), the census traces the step built in that configuration (SC12
+    fires when a quantized sync is configured but absent from the trace,
+    or when zero1 sharded nothing), and the report gains a ``traffic``
+    section with the modelled bytes-on-wire vs the fp32/none baseline.
     """
     config = config or DEFAULT_CONFIG
+    modes_active = optimizer_sharding != "none" or grad_allreduce != "fp32"
     leaves, specs = abstract_state_leaves(model_config)
     report = {
         "preset": name,
@@ -149,7 +179,16 @@ def check_preset(name, model_config, *, device_counts=(1, 2, 4, 8),
         "meshes": [],
         "memory": None,
         "census": None,
+        "traffic": None,
     }
+
+    def mode_leaves(mesh_shape):
+        return abstract_state_leaves(
+            model_config, optimizer_sharding=optimizer_sharding,
+            grad_allreduce=grad_allreduce, quant_block=quant_block,
+            mesh_shape=mesh_shape,
+        )
+
     rep_shape = None  # representative mesh for memory/census: last clean one
     rep_config = None
     for n in device_counts:
@@ -157,11 +196,30 @@ def check_preset(name, model_config, *, device_counts=(1, 2, 4, 8),
             mesh_configs if mesh_configs is not None
             else mesh_matrix(model_config, n)
         )
+        if grad_allreduce != "fp32":
+            # mirror the config-level composition rule: quantized
+            # gradient collectives launch on pure data-parallel replicas
+            # only (fsdp/tensor/expert/sequence/pipeline run their own
+            # collectives/manual regions) — checking unlaunchable meshes
+            # would report findings no real run can hit
+            matrix = [
+                m for m in matrix
+                if m.fsdp == 1 and m.tensor == 1 and m.expert == 1
+                and m.sequence == 1 and m.pipeline == 1
+            ]
         for mesh_cfg in matrix:
+            m_leaves, m_specs = leaves, specs
+            if modes_active:
+                try:
+                    m_leaves, m_specs = mode_leaves(
+                        resolve_mesh_shape(mesh_cfg, n)
+                    )
+                except ValueError:
+                    pass  # unresolvable mesh: preflight reports the SC01
             findings, mesh_shape = preflight(
                 model_config, mesh_cfg, n, config=config, locus=name,
                 batch_size=batch_size, seq_len=seq_len,
-                leaves=leaves, specs=specs,
+                leaves=m_leaves, specs=m_specs,
             )
             report["findings"].extend(findings)
             report["meshes"].append({
@@ -180,6 +238,28 @@ def check_preset(name, model_config, *, device_counts=(1, 2, 4, 8),
         rep_shape.get("data", 1) * rep_shape.get("fsdp", 1)
         * rep_shape.get("pipeline", 1)
     )
+    if modes_active:
+        try:
+            leaves, specs = mode_leaves(rep_shape)
+        except ValueError:
+            pass
+        if (
+            optimizer_sharding == "zero1"
+            and rep_shape.get("data", 1) > 1
+            and config.check_enabled("SC12")
+            and not any(
+                AXIS_DATA in _flat_axes(spec)
+                for (path, _, _), spec in zip(leaves, specs)
+                if path.startswith(".opt_state")
+            )
+        ):
+            report["findings"].append(make_finding(
+                "SC12", f"{name}@{mesh_desc(rep_shape)}",
+                "--optimizer-sharding zero1 is configured but NO optimizer-"
+                "state leaf resolved to a data-sharded spec — every "
+                "moment dim is indivisible by the data axis; the "
+                "optimizer stays fully replicated",
+            ))
     mem_rows, mem_findings = memory_budget(
         leaves, specs, rep_shape, model_config,
         batch_size=batch, seq_len=seq, config=config,
@@ -191,8 +271,13 @@ def check_preset(name, model_config, *, device_counts=(1, 2, 4, 8),
     report["memory"] = mem_rows
     report["findings"].extend(mem_findings)
 
+    param_leaves, param_specs = _param_only(leaves, specs)
+    report["traffic"] = traffic_model(
+        param_leaves, rep_shape,
+        grad_allreduce=grad_allreduce,
+        optimizer_sharding=optimizer_sharding, quant_block=quant_block,
+    )
     if run_census:
-        param_leaves, param_specs = _param_only(leaves, specs)
         n_dev = 1
         for v in rep_shape.values():
             n_dev *= v
@@ -208,6 +293,8 @@ def check_preset(name, model_config, *, device_counts=(1, 2, 4, 8),
             model_config, None, batch, seq, mesh=mesh, config=config,
             locus=f"{name}@{mesh_desc(rep_shape)}",
             param_leaves=param_leaves, param_specs=param_specs,
+            optimizer_sharding=optimizer_sharding,
+            grad_allreduce=grad_allreduce, quant_block=quant_block,
         )
         table["mesh"] = mesh_desc(rep_shape)
         table["analytic"] = analytic_collectives(
@@ -216,3 +303,15 @@ def check_preset(name, model_config, *, device_counts=(1, 2, 4, 8),
         report["census"] = table
         report["findings"].extend(census_findings)
     return report
+
+
+def _flat_axes(spec):
+    out = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            out.update(str(a) for a in entry)
+        else:
+            out.add(str(entry))
+    return out

@@ -356,9 +356,28 @@ def _sampler_rescale_check(sampler_state, target_topology):
     return info
 
 
+def live_target_specs(state):
+    """``{leaf keystr path: JSON-form spec}`` read off a LIVE state's
+    NamedShardings. This is the exact target layout the restore will
+    ``device_put`` onto — including configuration-dependent layouts the
+    static rules cannot know (zero1 data-sharded moments, the int8
+    error-feedback residual) — so the reshard plan computed against it
+    prices the real target grid, not the rule-derived default."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        sharding = getattr(leaf, "sharding", None)
+        if isinstance(sharding, NamedSharding):
+            out[jax.tree_util.keystr(path)] = spec_to_json(sharding.spec)
+    return out
+
+
 def preflight_elastic(manifest, saved_topology, target_topology, *,
                       sampler_state=None, device_kind=None,
-                      hbm_budget_fraction=0.9, locus="checkpoint"):
+                      hbm_budget_fraction=0.9, locus="checkpoint",
+                      target_specs=None):
     """The mandatory pre-restore gate. Returns ``(findings, plan)``.
 
     Findings use the shardcheck catalog: SC11 ``reshard-infeasible`` for
@@ -369,7 +388,10 @@ def preflight_elastic(manifest, saved_topology, target_topology, *,
     bound: failing it guarantees the restore cannot fit). An empty
     findings list means the restore may proceed.
     """
-    plan = compute_reshard_plan(manifest, saved_topology, target_topology)
+    plan = compute_reshard_plan(
+        manifest, saved_topology, target_topology,
+        target_specs=target_specs,
+    )
     findings = []
     for lp in plan.errors[:8]:
         findings.append(make_finding(
@@ -454,6 +476,7 @@ def resume_gate(mode, path, target_state, *, locus=None):
         manifest, saved_topo, target_topo,
         sampler_state=meta.get("sampler") or {},
         locus=locus or Path(path).name,
+        target_specs=live_target_specs(target_state),
     )
     if findings:
         reason = "; ".join(

@@ -50,6 +50,19 @@ class TrainConfig:
     adam_b2: float = 0.95
     grad_max_norm: float = 1.0
     grad_clipping: bool = True  # the reference defines but disables clipping (train.py:272)
+    # -- bandwidth-lean update path (README "Bandwidth-lean update path") -----
+    # "zero1": shard the AdamW moments (and the weight-update compute)
+    # across the data axis — reduce-scatter(grads) -> shard-local update
+    # -> allgather(updates), all inside the one jitted step; optimizer
+    # HBM per device drops by the data-axis size, and fp32 collectives
+    # stay bit-exact vs "none" (test- and chaos-gated)
+    optimizer_sharding: str = "none"  # none | zero1
+    # gradient-sync wire format over the data axis: fp32 (the implicit
+    # GSPMD allreduce), bf16 (cast, no feedback — the ablation baseline),
+    # or int8 (block-scaled with per-replica error-feedback residuals
+    # carried in the train state; parallel/collectives.py)
+    grad_allreduce: str = "fp32"  # fp32 | bf16 | int8
+    grad_quant_block: int = 256  # int8 block size (one f32 scale per block)
     training_steps: int = 1000
     seed: int = 42
     # -- model ---------------------------------------------------------------
@@ -136,6 +149,52 @@ class TrainConfig:
     profile_dir: str = "profiles/"
 
     def __post_init__(self):
+        if self.optimizer_sharding not in ("none", "zero1"):
+            raise ValueError(
+                f"unknown --optimizer-sharding {self.optimizer_sharding!r} "
+                "(expected none or zero1)"
+            )
+        if self.grad_allreduce not in ("fp32", "bf16", "int8"):
+            raise ValueError(
+                f"unknown --grad-allreduce {self.grad_allreduce!r} "
+                "(expected fp32, bf16 or int8)"
+            )
+        if self.grad_quant_block <= 0:
+            raise ValueError(
+                f"--grad-quant-block must be positive, got "
+                f"{self.grad_quant_block}"
+            )
+        if self.grad_allreduce != "fp32":
+            # the quantized sync runs its own shard_map manual over the
+            # data axis; schedules/axes with their OWN manual regions
+            # would nest inside it — rejected loudly instead of tracing
+            # into an unsupported composition
+            if self.pp_schedule == "1f1b" or self.mesh.pipeline > 1:
+                raise ValueError(
+                    "--grad-allreduce bf16/int8 does not compose with "
+                    "pipeline parallelism (the pipeline schedule runs its "
+                    "own manual region); use --grad-allreduce fp32 with --pp"
+                )
+            if self.mesh.sequence > 1:
+                raise ValueError(
+                    "--grad-allreduce bf16/int8 does not compose with "
+                    "sequence parallelism (ring attention runs its own "
+                    "manual region); use --grad-allreduce fp32 with --sp"
+                )
+            if (
+                self.mesh.fsdp > 1 or self.mesh.tensor > 1
+                or self.mesh.expert > 1
+            ):
+                # params sharded over fsdp/tensor/expert inside the
+                # data-manual sync region hit XLA's partial-manual
+                # partitioner weakness (hard CHECK failure, the same one
+                # models/moe.py and train_state._token_logprob document)
+                raise ValueError(
+                    "--grad-allreduce bf16/int8 supports pure data-"
+                    "parallel replicas (+zero1) only; fsdp/tensor/expert "
+                    "axes already shard their own collectives — use "
+                    "--grad-allreduce fp32 with them"
+                )
         # engine resolution: the explicit --checkpoint-engine wins; the
         # legacy --sharded-checkpoint boolean is kept in sync because the
         # sharded-specific machinery (Orbax checkpointer) keys off it
@@ -224,6 +283,24 @@ def build_parser():
                         "accumulate in f32 before one optimizer update.")
     p.add_argument("--weight-decay", type=float, default=d.weight_decay)
     p.add_argument("--grad-max-norm", type=float, default=d.grad_max_norm)
+    p.add_argument("--optimizer-sharding", type=str,
+                   default=d.optimizer_sharding, choices=["none", "zero1"],
+                   help="zero1: shard AdamW moments and the weight-update "
+                        "compute across the data axis (reduce-scatter grads "
+                        "-> shard-local update -> allgather updates, inside "
+                        "the jitted step); optimizer HBM per device drops "
+                        "by the data-axis size, fp32 numerics bit-exact.")
+    p.add_argument("--grad-allreduce", type=str, default=d.grad_allreduce,
+                   choices=["fp32", "bf16", "int8"],
+                   help="gradient-sync wire format over the data axis: "
+                        "fp32 (implicit GSPMD allreduce), bf16 (cast, no "
+                        "error feedback), int8 (block-scaled quantized "
+                        "collective with error-feedback residuals carried "
+                        "in the train state).")
+    p.add_argument("--grad-quant-block", type=int, default=d.grad_quant_block,
+                   help="int8 quantization block size: one f32 scale per "
+                        "this many gradient elements (default 256, ~1.6%% "
+                        "wire overhead).")
     p.add_argument("--no-grad-clipping", action="store_true",
                    help="Disable gradient clipping (the reference's accidental default, train.py:272).")
     p.add_argument("--training-steps", type=int, default=d.training_steps)
@@ -411,6 +488,9 @@ def get_args(argv=None):
         grad_accumulation_steps=ns.grad_accumulation_steps,
         weight_decay=ns.weight_decay,
         grad_max_norm=ns.grad_max_norm,
+        optimizer_sharding=ns.optimizer_sharding,
+        grad_allreduce=ns.grad_allreduce,
+        grad_quant_block=ns.grad_quant_block,
         grad_clipping=not ns.no_grad_clipping,
         training_steps=ns.training_steps,
         seed=ns.seed,

@@ -71,9 +71,13 @@ class ModelConfig:
     # (one (B,S,D) tensor per layer) so the backward skips recomputing the
     # whole attention sublayer — a little HBM for a chunk of the remat tax
     remat_policy: str = "full"
-    # tuned on v5e at 1B/seq-2048: 1024x1024 beats 512x512 by ~6% MFU
-    flash_block_q: int = 1024
-    flash_block_kv: int = 1024
+    # flash-attention (block_q, block_kv) tiling; 0 = auto-resolve from
+    # the per-device-kind defaults table (ops/flash_attention.py
+    # DEFAULT_BLOCKS, measured with tools/bench_flash_blocks.py — on v5e
+    # that resolves to the 1024x1024 the r03 sweep picked, ~6% MFU over
+    # 512x512 at 1B/seq-2048). Explicit values always win.
+    flash_block_q: int = 0
+    flash_block_kv: int = 0
     # -- mixture of experts (0 experts = dense; reference is dense-only) --
     n_experts: int = 0
     moe_top_k: int = 2
@@ -206,13 +210,19 @@ def rms_norm(x, scale, eps):
 
 def _attention_fn(config):
     if config.attention_impl == "flash":
-        from pyrecover_tpu.ops.flash_attention import flash_attention
-
-        return partial(
+        from pyrecover_tpu.ops.flash_attention import (
+            default_blocks,
             flash_attention,
-            block_q=config.flash_block_q,
-            block_kv=config.flash_block_kv,
         )
+
+        bq, bk = config.flash_block_q, config.flash_block_kv
+        if bq <= 0 or bk <= 0:
+            # auto: the per-device-kind defaults table (measured by
+            # tools/bench_flash_blocks.py); an explicit axis keeps its
+            # value while the other resolves
+            dq, dk = default_blocks()
+            bq, bk = (bq if bq > 0 else dq), (bk if bk > 0 else dk)
+        return partial(flash_attention, block_q=bq, block_kv=bk)
     if config.attention_impl == "ring":
         from pyrecover_tpu.ops.ring_attention import ring_attention
 

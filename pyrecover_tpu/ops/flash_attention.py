@@ -482,6 +482,43 @@ def _bwd(causal, scale, block_q, block_kv, res, g):
 
 # =========================== public API ====================================
 
+# Per-device-kind default (block_q, block_kv) tilings, measured with
+# tools/bench_flash_blocks.py at the flagship bench shape (seq 2048,
+# head_dim 128, bf16, fwd+bwd). The v5e row is the r03/BENCH sweep result
+# (1024×1024 beats 512×512 by ~6% MFU at 1B); the other generations are
+# seeded from it scaled by their VMEM headroom — REPLACE a row by
+# re-running the sweep on that hardware, then pin it in
+# tests/test_flash_attention.py::test_default_blocks_table. Matched by
+# substring against the lowered jax ``device_kind`` (the tpu_peak_flops
+# convention); unknown kinds get the conservative fallback.
+DEFAULT_BLOCKS = {
+    "v3": (256, 512),       # 16G HBM, small VMEM: conservative tiles
+    "v4": (512, 1024),
+    "v5e": (1024, 1024),    # measured (bench_flash_blocks, r03 sweep)
+    "v5litepod": (1024, 1024),
+    "v5 lite": (1024, 1024),
+    "v5p": (1024, 1024),
+    "v6e": (1024, 2048),    # Trillium: 2× VMEM of v5e, deeper kv tiles
+    "cpu": (512, 512),      # interpret mode — tile size is test speed
+}
+_FALLBACK_BLOCKS = (1024, 1024)  # the pre-table tuned default
+
+
+def default_blocks(device_kind=None):
+    """``(block_q, block_kv)`` for a device kind (the local device's when
+    None). Consumed by the model's attention builder whenever
+    ``flash_block_q/kv`` is 0 (= auto); explicit values always win."""
+    if device_kind is None:
+        try:
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            return _FALLBACK_BLOCKS
+    kind = str(device_kind).lower()
+    for key, blocks in DEFAULT_BLOCKS.items():
+        if key in kind:
+            return blocks
+    return _FALLBACK_BLOCKS
+
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def _flash(q, k, v, seg, causal, scale, block_q, block_kv):

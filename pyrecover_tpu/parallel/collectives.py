@@ -1,0 +1,197 @@
+"""Quantized cross-replica gradient collectives (pure JAX, inside jit).
+
+The data-parallel gradient sync is the per-step wire cost that scales
+with the model, not the batch: every step moves a full gradient copy
+through an allreduce over the ``data`` axis. EQuARX (arxiv 2506.17615)
+shows a block-scaled quantized allreduce recovers most of that bandwidth
+with negligible quality loss once the quantization error is fed back
+instead of accumulated. This module is the pure-JAX expression of that
+path, built so the whole thing stays inside the one jitted train step:
+
+  * :func:`block_quantize_int8` / :func:`block_dequantize_int8` —
+    symmetric int8 with one f32 scale per ``block`` elements (absmax
+    scaling). Wire cost per element: 1 byte + 4/block scale bytes
+    (~1.6% overhead at the default block of 256) vs 4 for f32.
+  * :func:`quantized_psum_flat` — the two-leg quantized allreduce over a
+    manual mesh axis (called inside ``jax.shard_map`` manual over
+    ``data``): reduce-scatter leg (``all_to_all`` of each replica's
+    quantized chunks), shard-local f32 accumulation, requantize,
+    allgather leg (``all_gather`` of the reduced quantized chunks). Both
+    legs move quantized bytes; the f32 sum never touches the wire.
+  * Error feedback: the deficit each replica owes the true sum — its own
+    leg-1 quantization error plus the leg-2 requantization error of the
+    chunk it reduced — is returned alongside the result. The train step
+    carries it in ``TrainState.grad_residual`` and adds it to the next
+    step's local gradient, so quantization error is compensated, not
+    compounded (EF-SGD; the int8-vs-fp32 parity tests gate this).
+
+``bf16`` mode reuses the same two-leg structure with a plain cast and NO
+error feedback — it exists as the ablation baseline the tests compare
+against (pure-bf16 drifts measurably worse than int8+feedback).
+
+Everything here is shape-static elementwise math plus one ``all_to_all``
+and one ``all_gather`` — no host callbacks, no syncs; XLA fuses it into
+the step program, and the shardcheck census sees the quantized
+collectives at jaxpr level (the SC12 wiring check keys off exactly
+that).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from pyrecover_tpu.parallel.mesh import AXIS_DATA
+
+GRAD_ALLREDUCE_MODES = ("fp32", "bf16", "int8")
+DEFAULT_QUANT_BLOCK = 256
+INT8_MAX = 127.0
+
+
+def wire_bytes_per_element(mode, block=DEFAULT_QUANT_BLOCK, elem_bytes=4):
+    """Modelled bytes-on-wire per gradient element for ONE collective leg.
+
+    int8 pays one f32 scale per ``block`` elements on top of the byte
+    payload; fp32 reports the element's own width (``elem_bytes`` lets
+    bf16-gradient models price their fp32 mode at 2 bytes).
+    """
+    if mode == "int8":
+        return 1.0 + 4.0 / int(block)
+    if mode == "bf16":
+        return 2.0
+    return float(elem_bytes)
+
+
+def padded_flat_len(param_count, replicas, block=DEFAULT_QUANT_BLOCK):
+    """Length of the flattened gradient vector after padding: a multiple
+    of ``replicas × block`` so it splits into per-replica chunks whose
+    length is a whole number of quantization blocks. The residual carried
+    in the train state uses the same formula — init and step must agree."""
+    unit = max(int(replicas), 1) * int(block)
+    return -(-int(param_count) // unit) * unit
+
+
+def flatten_grads(grads, padded_len):
+    """Concat every gradient leaf into one f32 vector of ``padded_len``
+    (zero-padded) plus the inverse: rebuild the tree at each leaf's
+    original shape AND dtype."""
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    flat = jnp.concatenate(
+        [leaf.astype(jnp.float32).reshape(-1) for leaf in leaves]
+    )
+    n = flat.shape[0]
+    if padded_len < n:
+        raise ValueError(
+            f"padded_len {padded_len} < flattened gradient size {n}"
+        )
+    if padded_len > n:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((padded_len - n,), jnp.float32)]
+        )
+
+    def unflatten(vec):
+        out, off = [], 0
+        for leaf in leaves:
+            out.append(
+                vec[off:off + leaf.size].reshape(leaf.shape).astype(leaf.dtype)
+            )
+            off += leaf.size
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    return flat, unflatten
+
+
+def block_quantize_int8(x, block=DEFAULT_QUANT_BLOCK):
+    """Symmetric block-scaled int8: ``x`` is ``(..., L)`` with ``L %
+    block == 0``. Returns ``(q int8 of x.shape, scales f32 of (...,
+    L//block))``. All-zero blocks get scale 1 so dequantization is exact
+    for them (0/1 -> 0)."""
+    shape = x.shape
+    blocks = x.reshape(*shape[:-1], shape[-1] // block, block)
+    absmax = jnp.max(jnp.abs(blocks), axis=-1)
+    scale = jnp.where(absmax > 0, absmax / INT8_MAX, 1.0).astype(jnp.float32)
+    q = jnp.clip(
+        jnp.round(blocks / scale[..., None]), -INT8_MAX, INT8_MAX
+    ).astype(jnp.int8)
+    return q.reshape(shape), scale
+
+
+def block_dequantize_int8(q, scale, block=DEFAULT_QUANT_BLOCK):
+    shape = q.shape
+    blocks = q.astype(jnp.float32).reshape(*shape[:-1], shape[-1] // block, block)
+    return (blocks * scale[..., None].astype(jnp.float32)).reshape(shape)
+
+
+def _quantize_leg(x, mode, block):
+    """One wire leg: quantize -> (payload, dequantized view). The caller
+    moves ``payload`` (and scales, for int8) over the collective; the
+    dequantized view is what the receiving side reconstructs."""
+    if mode == "int8":
+        q, s = block_quantize_int8(x, block)
+        return (q, s), block_dequantize_int8(q, s, block)
+    # bf16: the payload IS the cast; no scales
+    q = x.astype(jnp.bfloat16)
+    return (q, None), q.astype(jnp.float32)
+
+
+def quantized_psum_flat(x, *, mode, block=DEFAULT_QUANT_BLOCK,
+                        axis_name=AXIS_DATA):
+    """Allreduce a per-replica flat f32 vector with a quantized wire.
+
+    Must run inside a ``shard_map`` manual over ``axis_name``; ``x`` is
+    this replica's local partial sum, length a multiple of ``axis_size ×
+    block`` (see :func:`padded_flat_len`). Returns ``(reduced,
+    deficit)``: ``reduced`` is the (identically replicated) quantized
+    approximation of ``sum_r x_r``; ``deficit`` is what THIS replica owes
+    the true sum — its leg-1 error over the full vector plus the leg-2
+    requantization error of the chunk it owns — such that ``sum_r
+    (reduced + deficit_r) == sum_r x_r`` exactly. ``deficit`` is None in
+    bf16 mode (no feedback, by design — the ablation baseline).
+    """
+    n = jax.lax.axis_size(axis_name)
+    L = x.shape[0]
+    chunk = L // n
+    chunks = x.reshape(n, chunk)
+
+    # leg 1 (reduce-scatter): every replica quantizes its n chunks and
+    # sends chunk j to replica j — the wire moves quantized bytes
+    (q1, s1), deq1 = _quantize_leg(chunks, mode, block)
+    q1_t = jax.lax.all_to_all(q1, axis_name, 0, 0)
+    if s1 is not None:
+        s1_t = jax.lax.all_to_all(s1, axis_name, 0, 0)
+        recv = block_dequantize_int8(q1_t, s1_t, block)
+    else:
+        recv = q1_t.astype(jnp.float32)
+    mine = jnp.sum(recv, axis=0)  # (chunk,) — the f32 sum stays local
+
+    # leg 2 (allgather): requantize the reduced chunk, gather every
+    # owner's quantized chunk — again only quantized bytes on the wire
+    (q2, s2), deq2 = _quantize_leg(mine[None, :], mode, block)
+    q2_g = jax.lax.all_gather(q2[0], axis_name, axis=0, tiled=False)
+    if s2 is not None:
+        s2_g = jax.lax.all_gather(s2[0], axis_name, axis=0, tiled=False)
+        reduced = block_dequantize_int8(
+            q2_g.reshape(n, chunk), s2_g.reshape(n, -1), block
+        ).reshape(L)
+    else:
+        reduced = q2_g.astype(jnp.float32).reshape(L)
+
+    if mode == "bf16":
+        return reduced, None
+    err1 = (chunks - deq1).reshape(L)
+    err2 = mine - deq2[0]
+    r = jax.lax.axis_index(axis_name)
+    deficit = err1 + jax.lax.dynamic_update_slice(
+        jnp.zeros((L,), jnp.float32), err2, (r * chunk,)
+    )
+    return reduced, deficit
+
+
+def quantized_roundtrip_local(x, *, mode, block=DEFAULT_QUANT_BLOCK):
+    """The degenerate single-replica form of :func:`quantized_psum_flat`:
+    no wire, but the SAME quantize/dequantize numerics and error-feedback
+    contract, so a 1-device run behaves like the n-replica path's n=1
+    case (and the parity tests exercise identical math)."""
+    _, deq = _quantize_leg(x[None, :], mode, block)
+    reduced = deq[0]
+    if mode == "bf16":
+        return reduced, None
+    return reduced, x - reduced

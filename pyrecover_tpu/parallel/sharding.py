@@ -87,11 +87,123 @@ def spec_for_manifest_path(path_str, ndim):
 
         _KEYSTR_TOKEN = re.compile(r"\['([^']+)'\]|\.([A-Za-z_]\w*)|\[(\d+)\]")
     keys = [a or b or c for a, b, c in _KEYSTR_TOKEN.findall(path_str or "")]
+    if "grad_residual" in keys:
+        # per-replica error-feedback residual (quantized grad collectives):
+        # leading replica dim on the data axis, payload dims replicated
+        return grad_residual_spec(ndim)
     for key in reversed(keys):
         rule = _RULES.get(key)
         if rule is not None:
             return rule if len(rule) == ndim else P(*([None] * ndim))
     return P(*([None] * ndim))
+
+
+# ---- ZeRO-1 cross-replica optimizer sharding (arxiv 2004.13336) -------------
+#
+# The data axis replicates parameters, so without help it also replicates
+# the AdamW moments — 2× param bytes of optimizer state on EVERY replica.
+# ZeRO-1 shards the weight-update computation across the data axis
+# instead: moments carry the param rule PLUS the data axis on the first
+# dimension it divides, the train step constrains gradients to the same
+# specs before the optax update (XLA turns the DP allreduce into a
+# reduce-scatter), the update runs shard-local, and the updates are
+# constrained back to the param rules (the allgather). Per-device
+# optimizer bytes drop by the data-axis size; the program semantics are
+# unchanged, which is what makes the zero1-fp32 parity gate bit-exact.
+
+
+def _rule_entries(rule, ndim):
+    """Rule entries normalized to per-dim axis tuples, length ``ndim``."""
+    entries = []
+    for e in rule:
+        if e is None:
+            entries.append(())
+        elif isinstance(e, (tuple, list)):
+            entries.append(tuple(e))
+        else:
+            entries.append((e,))
+    entries += [()] * (ndim - len(entries))
+    return entries
+
+
+def _entries_to_spec(entries):
+    return P(*[
+        (e[0] if len(e) == 1 else e) if e else None for e in entries
+    ])
+
+
+def zero1_leaf_spec(rule, shape, mesh_shape):
+    """The zero1 spec for an optimizer-moment leaf: ``rule`` with the
+    data axis appended to the first dimension whose size the combined
+    axis product divides. Falls back to ``rule`` unchanged when no
+    dimension divides (the leaf stays replicated over data — graceful,
+    and shardcheck's SC12 reports a zero1 config where NOTHING sharded).
+    """
+    data = int(mesh_shape.get(AXIS_DATA, 1))
+    if rule is None:
+        rule = P(*([None] * len(shape)))
+    if data <= 1:
+        return rule
+    entries = _rule_entries(rule, len(shape))
+    if any(AXIS_DATA in e for e in entries):
+        return rule  # already data-sharded; nothing to add
+    for dim, axes in enumerate(entries):
+        factor = 1
+        for a in axes:
+            factor *= int(mesh_shape.get(a, 1))
+        if shape[dim] % (factor * data) == 0:
+            entries[dim] = tuple(axes) + (AXIS_DATA,)
+            return _entries_to_spec(entries)
+    return rule
+
+
+def grad_residual_spec(ndim=2):
+    """Spec for the error-feedback residual carried by the quantized
+    gradient path (parallel/collectives.py): shape ``(replicas, L)``
+    with the leading per-replica dim on the data axis."""
+    return P(AXIS_DATA, *([None] * (ndim - 1)))
+
+
+def _ambient_mesh_shape():
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return None
+    return {str(k): int(v) for k, v in dict(mesh.shape).items()}
+
+
+def zero1_constrain(tree):
+    """Constrain a param-shaped tree (gradients) to the zero1 specs under
+    the ambient mesh — the reduce-scatter half of the decomposed update.
+    No-op without a mesh or with a trivial data axis."""
+    mesh_shape = _ambient_mesh_shape()
+    if mesh_shape is None or mesh_shape.get(AXIS_DATA, 1) <= 1:
+        return tree
+
+    def f(path, leaf):
+        rule = _leaf_rule(path)
+        if rule is None or len(rule) != leaf.ndim:
+            rule = P(*([None] * leaf.ndim))
+        return jax.lax.with_sharding_constraint(
+            leaf, zero1_leaf_spec(rule, leaf.shape, mesh_shape)
+        )
+
+    return jax.tree_util.tree_map_with_path(f, tree)
+
+
+def rules_constrain(tree):
+    """Constrain a param-shaped tree (updates) back to the base param
+    rules — the allgather half of the decomposed update."""
+    mesh_shape = _ambient_mesh_shape()
+    if mesh_shape is None or mesh_shape.get(AXIS_DATA, 1) <= 1:
+        return tree
+
+    def f(path, leaf):
+        rule = _leaf_rule(path)
+        if rule is None or len(rule) != leaf.ndim:
+            rule = P(*([None] * leaf.ndim))
+        return jax.lax.with_sharding_constraint(leaf, rule)
+
+    return jax.tree_util.tree_map_with_path(f, tree)
 
 
 def param_pspecs(params):

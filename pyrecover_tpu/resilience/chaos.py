@@ -38,6 +38,13 @@ A smoke soak is four trainer runs over one experiment directory::
                golden, every kill site fired, a torn save leaving the
                previous manifest restorable (no quarantines), and zero
                chunks leaked after GC
+    cycles 16-18: zero1 flag-flip drill in its own exp dirs, pinned to a
+               2-device mesh — a --optimizer-sharding zero1 golden, a
+               zero1 run SIGTERM'd at s1, then a resume with the flag
+               flipped to none; gated on the stitched CSV matching the
+               zero1 golden BIT-EXACTLY (zero1 is semantically the
+               replicated update) and the spec-drifted checkpoint
+               restoring without quarantine
 
 Verdicts: per-cycle exit codes, stitched CSV == golden CSV, exactly the
 injected corruption quarantined (zero non-injected losses), and the
@@ -378,6 +385,26 @@ def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
     cycle("zs_recover", resume=True, expect_rc=(0,), exp="zs",
           extra_args=zs_args, sync_ckpt=False, fault_plan=None)
 
+    # cycles 16-18 — zero1 flag-flip drill (own exp dirs, pinned to a
+    # 2-device virtual mesh so the data axis is real): a golden run with
+    # --optimizer-sharding zero1 throughout, a zero1 run killed at s1,
+    # then a resume with the flag FLIPPED back to none. Because zero1 is
+    # bit-exact vs none at the same topology (the decomposed update is
+    # semantically the replicated update), the stitched CSV must match
+    # the zero1 golden BIT-EXACTLY even across the flag flip — proving
+    # both the numerics claim and that a zero1 checkpoint restores onto
+    # a none run (spec-only drift) without quarantine.
+    z1_args = ("--optimizer-sharding", "zero1")
+    cycle("z1_golden", resume=False, expect_rc=(0,), exp="z1_golden",
+          fault_plan=None, extra_args=z1_args, device_count=2)
+    cycle("z1_kill@zero1", resume=False, expect_rc=(0,), exp="z1",
+          device_count=2, extra_args=z1_args, fault_plan={
+              "seed": seed,
+              "faults": [{"type": "sigterm_at_step", "step": s1}],
+          })
+    cycle("z1_flip_resume@none", resume=True, expect_rc=(0,), exp="z1",
+          device_count=2, fault_plan=None)
+
     exp_dir = workdir / "chaos"
     golden_rows = _read_csv_rows(
         workdir / "golden" / "golden_loss_log.csv"
@@ -580,6 +607,54 @@ def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
             f"from the store (e.g. {missing[:3]}) — live manifests are "
             "not restorable"
         )
+    # zero1 flag-flip drill verdicts: the stitched CSV (zero1 segment +
+    # post-flip none segment) must be BIT-EXACT against the zero1 golden
+    # — the convergence-parity contract of the bandwidth-lean update
+    # path — and the flip must restore without quarantining (the zero1
+    # checkpoint differs from the none run's schema only in partition
+    # specs, SC10, a warning)
+    z1_dir = workdir / "z1"
+    z1_golden_rows = _read_csv_rows(
+        workdir / "z1_golden" / "z1_golden_loss_log.csv"
+    )
+    z1_rows = _read_csv_rows(z1_dir / "z1_loss_log.csv")
+    z1_divergence = None
+    for i, (a, b) in enumerate(zip(z1_golden_rows, z1_rows)):
+        if a != b:
+            z1_divergence = {"row": i, "golden": a, "stitched": b}
+            break
+    z1_continuity = (
+        z1_divergence is None
+        and len(z1_rows) == len(z1_golden_rows) == steps + 1
+    )
+    if not z1_continuity:
+        violations.append(
+            "zero1 drill: flag-flip loss continuity broken: "
+            + (json.dumps(z1_divergence) if z1_divergence else
+               f"{len(z1_rows)} stitched rows vs {len(z1_golden_rows)} "
+               f"golden (want {steps + 1})")
+        )
+    if not (z1_dir / "DONE").exists():
+        violations.append(
+            "zero1 drill: no DONE marker after the flag-flip resume"
+        )
+    z1_quarantined = [p.name for p in list_quarantined(z1_dir)]
+    if z1_quarantined:
+        violations.append(
+            "zero1 drill: the flag flip must restore the zero1 checkpoint "
+            f"intact, but {z1_quarantined} got quarantined"
+        )
+    z1_events = read_events(z1_dir / "z1_telemetry.jsonl")
+    if not any(e["event"] == "resume" for e in z1_events):
+        violations.append("zero1 drill: no resume event after the kill")
+    z1_info = {
+        "rows": len(z1_rows),
+        "continuity_ok": z1_continuity,
+        "bitexact": z1_divergence is None,
+        "quarantined": z1_quarantined,
+        "resumes": sum(1 for e in z1_events if e["event"] == "resume"),
+    }
+
     zs_info = {
         "rows": len(zs_rows),
         "continuity_ok": zs_continuity,
@@ -617,6 +692,7 @@ def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
         },
         "elastic": elastic_info,
         "zerostall": zs_info,
+        "zero1": z1_info,
         "telemetry_rotated_shards": rotated,
         "telemetry_counts": {
             k: counts.get(k, 0)
@@ -675,6 +751,11 @@ def main(argv=None):
           f"{zs.get('chunks_on_disk')} on disk = "
           f"{zs.get('chunks_referenced')} referenced "
           f"({zs.get('chunks_leaked')} leaked)")
+    z1 = report.get("zero1") or {}
+    print(f"  zero1 flag-flip: "
+          f"{'bit-exact' if z1.get('bitexact') else 'DIVERGED'} "
+          f"({z1.get('rows')} rows) | {z1.get('resumes')} resumes | "
+          f"quarantined: {z1.get('quarantined')}")
     if report["violations"]:
         for v in report["violations"]:
             print(f"  VIOLATION: {v}")

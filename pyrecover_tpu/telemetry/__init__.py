@@ -62,6 +62,12 @@ Core event names across the stack (fields beyond the envelope):
     sampler_rescaled  saved_replicas, target_replicas, consumed (the data
                       pipeline re-derived its per-replica split; global
                       sample order preserved exactly)
+    grad_quantize     mode, optimizer_sharding, block, data_replicas,
+                      error_feedback, grad_bytes_fp32, wire_bytes_per_leg
+                      (once per run when the bandwidth-lean update path is
+                      on: the wire format the step was BUILT to move, with
+                      the modelled per-leg bytes — shardcheck's traffic
+                      model carries the full before/after ledger)
     preempt_check     step, time_left_s, threshold_s
     preempt_notice / preempt_stop / preempt_estimate
     preempt_signal_escalation  signal, count, step (2nd signal mid-save)

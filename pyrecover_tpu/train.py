@@ -57,30 +57,61 @@ from pyrecover_tpu.utils.perf import get_num_params
 _BG_JOIN_TIMEOUT_S = 600.0
 
 
-def state_pspecs(abstract_state):
+def state_pspecs(abstract_state, optimizer_sharding="none", mesh_shape=None):
     """PartitionSpecs for the FULL train state. Optimizer moments mirror the
     params pytree (same leaf names), so the same path rules shard them
-    identically; anything unmatched (counters, RNG) is replicated."""
+    identically; anything unmatched (counters, RNG) is replicated.
+
+    ``optimizer_sharding="zero1"`` (with a ``mesh_shape`` dict for the
+    divisibility decisions) additionally shards every ``.opt_state``
+    moment over the data axis (parallel/sharding.py:zero1_leaf_spec) —
+    the ZeRO-1 layout the decomposed update in make_train_step computes
+    against. The error-feedback residual (``.grad_residual``, present
+    only under int8 gradient collectives) always carries its per-replica
+    leading dim on the data axis."""
+    from pyrecover_tpu.parallel.sharding import (
+        grad_residual_spec,
+        zero1_leaf_spec,
+    )
 
     def spec_for(path, leaf):
+        root = str(getattr(path[0], "name", "")) if path else ""
+        if root == "grad_residual":
+            return grad_residual_spec(leaf.ndim)
         rule = _leaf_rule(path)
-        if rule is not None and len(rule) == leaf.ndim:
-            return rule
-        return P(*([None] * leaf.ndim))
+        if rule is None or len(rule) != leaf.ndim:
+            rule = P(*([None] * leaf.ndim))
+        if (
+            optimizer_sharding == "zero1"
+            and mesh_shape
+            and root == "opt_state"
+        ):
+            return zero1_leaf_spec(rule, leaf.shape, mesh_shape)
+        return rule
 
     return jax.tree_util.tree_map_with_path(spec_for, abstract_state)
 
 
-def init_sharded_state(rng, model_config, optimizer, mesh):
+def init_sharded_state(rng, model_config, optimizer, mesh,
+                       optimizer_sharding="none", grad_allreduce="fp32",
+                       grad_quant_block=None):
     """Initialize the train state directly INTO its shardings: params are
     compiled to materialize shard-local (no host-memory or single-device
     staging), which is what makes >HBM-sized models initializable."""
+    mesh_shape = {str(k): int(v) for k, v in dict(mesh.shape).items()}
+    residual_replicas = (
+        mesh_shape.get("data", 1) if grad_allreduce == "int8" else 0
+    )
 
     def init_fn(key):
-        return create_train_state(key, model_config, optimizer)
+        return create_train_state(
+            key, model_config, optimizer,
+            grad_residual_replicas=residual_replicas,
+            grad_quant_block=grad_quant_block,
+        )
 
     abstract = jax.eval_shape(init_fn, rng)
-    specs = state_pspecs(abstract)
+    specs = state_pspecs(abstract, optimizer_sharding, mesh_shape)
     shardings = jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s), specs,
         is_leaf=lambda x: isinstance(x, P),
@@ -658,7 +689,12 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
 
     optimizer, _ = build_optimizer(config)
     rng = jax.random.key(config.seed)
-    state = init_sharded_state(rng, model_config, optimizer, mesh)
+    state = init_sharded_state(
+        rng, model_config, optimizer, mesh,
+        optimizer_sharding=config.optimizer_sharding,
+        grad_allreduce=config.grad_allreduce,
+        grad_quant_block=config.grad_quant_block,
+    )
     n_params = get_num_params(state.params)
     log_host0("Model: %.2fM params | %s", n_params / 1e6, model_config)
 
@@ -930,7 +966,46 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
         step_fn = make_train_step(
             model_config, optimizer, loss_chunk_size=config.loss_chunk_size,
             grad_accumulation_steps=config.grad_accumulation_steps,
+            optimizer_sharding=config.optimizer_sharding,
+            grad_allreduce=config.grad_allreduce,
+            grad_quant_block=config.grad_quant_block,
         )
+        if config.grad_allreduce != "fp32" or (
+            config.optimizer_sharding != "none"
+        ):
+            # one host-side record of the bandwidth-lean configuration —
+            # modelled wire bytes for the gradient sync so a telemetry
+            # stream (and the doctor/summarizer) can see what the step
+            # was built to move without re-deriving the traffic model
+            from pyrecover_tpu.parallel.collectives import (
+                DEFAULT_QUANT_BLOCK,
+                wire_bytes_per_element,
+            )
+
+            mesh_shape = dict(mesh.shape)
+            replicas = int(mesh_shape.get("data", 1))
+            grad_elems = sum(
+                int(x.size) for x in jax.tree_util.tree_leaves(state.params)
+            )
+            grad_bytes = sum(
+                int(x.size) * x.dtype.itemsize
+                for x in jax.tree_util.tree_leaves(state.params)
+            )
+            block = config.grad_quant_block or DEFAULT_QUANT_BLOCK
+            bpe = wire_bytes_per_element(
+                config.grad_allreduce, block,
+                elem_bytes=grad_bytes / max(grad_elems, 1),
+            )
+            telemetry.emit(
+                "grad_quantize",
+                mode=config.grad_allreduce,
+                optimizer_sharding=config.optimizer_sharding,
+                block=int(block),
+                data_replicas=replicas,
+                error_feedback=config.grad_allreduce == "int8",
+                grad_bytes_fp32=grad_bytes,
+                wire_bytes_per_leg=int(grad_elems * bpe),
+            )
         # recompile detector: an abstract-signature change on the jitted
         # step is a genuine retrace — one `recompile` event per drift, so
         # a recompile storm can't silently eat throughput

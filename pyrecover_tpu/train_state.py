@@ -33,23 +33,46 @@ class TrainState:
     step: jax.Array  # int32 scalar
     epoch: jax.Array  # int32 scalar (reference tracks epoch alongside step)
     rng: jax.Array  # raw uint32 key data (jax.random.key_data form)
+    # per-replica error-feedback residual for the quantized gradient
+    # collectives (parallel/collectives.py): f32 of shape (data_replicas,
+    # padded_flat_param_count), data-sharded on dim 0. None (an EMPTY
+    # pytree node — zero leaves, so checkpoints without it keep their
+    # schema) whenever --grad-allreduce is not int8.
+    grad_residual: Any = None
 
     def next_key(self):
         return jax.random.wrap_key_data(self.rng)
 
 
-def create_train_state(rng, model_config, optimizer, params=None):
+def create_train_state(rng, model_config, optimizer, params=None,
+                       grad_residual_replicas=0,
+                       grad_quant_block=None):
     from pyrecover_tpu.models.llama import init_params
 
     if params is None:
         params = init_params(rng, model_config)
     opt_state = optimizer.init(params)
+    grad_residual = None
+    if grad_residual_replicas > 0:
+        from pyrecover_tpu.parallel.collectives import (
+            DEFAULT_QUANT_BLOCK,
+            padded_flat_len,
+        )
+
+        n_elems = sum(x.size for x in jax.tree_util.tree_leaves(params))
+        grad_residual = jnp.zeros(
+            (int(grad_residual_replicas),
+             padded_flat_len(n_elems, grad_residual_replicas,
+                             grad_quant_block or DEFAULT_QUANT_BLOCK)),
+            jnp.float32,
+        )
     return TrainState(
         params=params,
         opt_state=opt_state,
         step=jnp.zeros((), dtype=jnp.int32),
         epoch=jnp.zeros((), dtype=jnp.int32),
         rng=jax.random.key_data(rng),
+        grad_residual=grad_residual,
     )
 
 
@@ -246,7 +269,9 @@ def _pipelined_1f1b_value_and_grad(params, batch, model_config,
 
 
 def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0,
-                    grad_accumulation_steps=1):
+                    grad_accumulation_steps=1, optimizer_sharding="none",
+                    grad_allreduce="fp32", grad_quant_block=None,
+                    grad_error_feedback=True):
     """Build the jitted functional train step.
 
     state, batch → new_state, metrics. Under a mesh, batch/params shardings
@@ -260,11 +285,64 @@ def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0,
     from the labels up front (data-only, no model), so each micro-step's
     objective is ``Σ_chunk CE / N_total`` and the accumulated f32 gradient
     equals the unaccumulated one.
+
+    Bandwidth-lean update path (both opt-in, composable, still ONE jitted
+    program):
+
+    * ``optimizer_sharding="zero1"`` — the decomposed cross-replica
+      weight update (arxiv 2004.13336): gradients are constrained to the
+      zero1 specs before the optax update (XLA lowers the DP allreduce
+      to a reduce-scatter), the AdamW update runs shard-local against
+      data-sharded moments, and the updates are constrained back to the
+      param rules (the allgather). Same semantics as the replicated
+      update — the zero1-fp32 parity gate is bit-exact — with optimizer
+      HBM divided by the data-axis size.
+    * ``grad_allreduce="int8"|"bf16"`` — the gradient sync over the data
+      axis runs as an EXPLICIT block-scaled quantized allreduce
+      (parallel/collectives.py) inside a ``shard_map`` manual over
+      ``data``: per-replica partial gradients are computed on the local
+      batch shard (every other mesh axis stays under GSPMD), compensated
+      with the error-feedback residual carried in
+      ``state.grad_residual`` (int8 only), and reduced with quantized
+      bytes on both wire legs. Composes with pure DP, fsdp and tensor;
+      the 1f1b pipeline schedule and sequence parallelism are rejected
+      at config time (their own manual regions would nest).
     """
     A = int(grad_accumulation_steps)
     if A < 1:
         raise ValueError(
             f"grad_accumulation_steps must be >= 1, got {grad_accumulation_steps}"
+        )
+    if optimizer_sharding not in ("none", "zero1"):
+        raise ValueError(
+            f"optimizer_sharding must be 'none' or 'zero1', "
+            f"got {optimizer_sharding!r}"
+        )
+    if optimizer_sharding == "zero1" and not getattr(
+        optimizer.update, "_pyrecover_zero1", False
+    ):
+        raise ValueError(
+            "optimizer_sharding='zero1' requires the optimizer built by "
+            "build_optimizer with config.optimizer_sharding='zero1' (the "
+            "zero1_wrap carries the sharded update; a plain optimizer "
+            "would silently train unsharded)"
+        )
+    from pyrecover_tpu.parallel.collectives import (
+        DEFAULT_QUANT_BLOCK,
+        GRAD_ALLREDUCE_MODES,
+    )
+
+    if grad_allreduce not in GRAD_ALLREDUCE_MODES:
+        raise ValueError(
+            f"grad_allreduce must be one of {GRAD_ALLREDUCE_MODES}, "
+            f"got {grad_allreduce!r}"
+        )
+    use_quant = grad_allreduce != "fp32"
+    quant_block = int(grad_quant_block or DEFAULT_QUANT_BLOCK)
+    if use_quant and model_config.pp_schedule == "1f1b":
+        raise ValueError(
+            "--grad-allreduce bf16/int8 composes with the gpipe schedule "
+            "only; the 1f1b pipeline runs its own manual region"
         )
     if model_config.pp_schedule == "1f1b" and A > 1:
         raise ValueError(
@@ -297,6 +375,153 @@ def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0,
             )
         return total, moe_aux
 
+    def _local_value_and_grad(params, inputs, labels, segs, n_total, B):
+        """Per-replica value-and-grad of the LOCAL batch shard, objective
+        ``Σ_chunk CE / N_total`` so partial grads SUM over replicas to the
+        full-batch grads (micro_loss's invariant, reused shard-side).
+        Handles grad accumulation by scanning local micro-batches.
+        Returns ``(grads, ce_sum, n_valid, aux_rowsum)`` — all LOCAL."""
+        from pyrecover_tpu.models.llama import forward_hidden_with_aux
+
+        def loss_local(p, inp, lab, sg):
+            hidden, moe_aux = forward_hidden_with_aux(
+                p, inp, model_config, segment_ids=sg
+            )
+            ce, n = chunked_ce(p, hidden, lab, model_config, loss_chunk_size)
+            ce_sum = ce * jnp.maximum(n, 1).astype(jnp.float32)
+            obj = ce_sum / n_total
+            aux_rows = moe_aux * (inp.shape[0] / B)
+            if model_config.n_experts > 0:
+                obj = obj + model_config.moe_aux_weight * aux_rows
+            return obj, (ce_sum, n, aux_rows)
+
+        rows = inputs.shape[0]
+        if A == 1:
+            (_, (ce_sum, n_valid, aux)), g = jax.value_and_grad(
+                loss_local, has_aux=True
+            )(params, inputs, labels, segs)
+            return g, ce_sum, n_valid, aux
+        if rows % A:
+            raise ValueError(
+                f"local batch {rows} not divisible by "
+                f"grad_accumulation_steps {A}"
+            )
+        inp = inputs.reshape(A, rows // A, -1)
+        lab = labels.reshape(A, rows // A, -1)
+        sgs = None if segs is None else segs.reshape(A, rows // A, -1)
+
+        def micro(acc, xs):
+            i_, l_, s_ = xs if sgs is not None else (*xs, None)
+            (_, (cs, nv, aw)), g_ = jax.value_and_grad(
+                loss_local, has_aux=True
+            )(params, i_, l_, s_)
+            acc_g, acs, anv, aaw = acc
+            acc_g = jax.tree_util.tree_map(
+                lambda a, b: a + b.astype(jnp.float32), acc_g, g_
+            )
+            return (acc_g, acs + cs, anv + nv, aaw + aw), None
+
+        zero_g = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        xs = (inp, lab) if sgs is None else (inp, lab, sgs)
+        (g, ce_sum, n_valid, aux), _ = jax.lax.scan(
+            micro, (zero_g, jnp.float32(0), jnp.int32(0), jnp.float32(0)), xs
+        )
+        g = jax.tree_util.tree_map(
+            lambda x, p: x.astype(p.dtype), g, params
+        )
+        return g, ce_sum, n_valid, aux
+
+    def _quantized_grads(state, batch, segments):
+        """Gradients with the quantized cross-replica sync: per-replica
+        partials inside a data-manual shard_map, error-feedback
+        compensation (int8), quantized reduce-scatter + allgather legs.
+        Returns ``(grads, loss, n_valid, moe_aux, new_residual)``."""
+        from pyrecover_tpu.parallel.collectives import (
+            flatten_grads,
+            padded_flat_len,
+            quantized_psum_flat,
+            quantized_roundtrip_local,
+        )
+        from pyrecover_tpu.parallel.mesh import AXIS_DATA
+
+        mesh = jax.sharding.get_abstract_mesh()
+        data_n = (
+            int(dict(mesh.shape).get(AXIS_DATA, 1))
+            if mesh is not None and not mesh.empty else 1
+        )
+        B = batch["inputs"].shape[0]
+        n_elems = sum(
+            x.size for x in jax.tree_util.tree_leaves(state.params)
+        )
+        pad_len = padded_flat_len(n_elems, data_n, quant_block)
+        residual = state.grad_residual
+
+        def sync_region(params, inputs, labels, segs, res):
+            from pyrecover_tpu.parallel.mesh import constraints_disabled
+
+            manual = data_n > 1
+            n_local = jnp.sum(labels != IGNORE_INDEX)
+            n_total = (
+                jax.lax.psum(n_local, AXIS_DATA) if manual else n_local
+            )
+            n_total = jnp.maximum(n_total, 1).astype(jnp.float32)
+            # constraints off inside the manual region (the 1f1b
+            # precedent): the model's reshard waypoints name the data
+            # axis, which is manually bound here; propagation from the
+            # already-sharded inputs carries the fsdp/tensor layouts
+            with constraints_disabled():
+                g, ce_sum, n_valid, aux = _local_value_and_grad(
+                    params, inputs, labels, segs, n_total, B
+                )
+            flat, unflatten = flatten_grads(g, pad_len)
+            # error feedback: re-inject last step's deficit before
+            # quantizing (grad_error_feedback=False is the test-only
+            # ablation knob proving the mechanism matters)
+            use_feedback = res is not None and grad_error_feedback
+            if use_feedback:
+                flat = flat + res[0]
+            if manual:
+                reduced, deficit = quantized_psum_flat(
+                    flat, mode=grad_allreduce, block=quant_block,
+                    axis_name=AXIS_DATA,
+                )
+                ce_sum = jax.lax.psum(ce_sum, AXIS_DATA)
+                n_valid = jax.lax.psum(n_valid, AXIS_DATA)
+                aux = jax.lax.psum(aux, AXIS_DATA)
+            else:
+                reduced, deficit = quantized_roundtrip_local(
+                    flat, mode=grad_allreduce, block=quant_block
+                )
+            if deficit is None or res is None:
+                new_res = res  # bf16 / no residual: nothing carried
+            elif grad_error_feedback:
+                new_res = deficit[None, :]
+            else:
+                new_res = res  # ablation: deficit computed, never fed back
+            return unflatten(reduced), ce_sum / n_total, n_valid, aux, new_res
+
+        if data_n > 1:
+            from jax.sharding import PartitionSpec as P
+
+            shard = P(AXIS_DATA)
+            outs = jax.shard_map(
+                sync_region,
+                mesh=mesh,
+                in_specs=(P(), shard, shard, shard, shard),
+                out_specs=(P(), P(), P(), P(), shard),
+                axis_names={AXIS_DATA},
+                check_vma=False,
+            )(state.params, batch["inputs"], batch["labels"], segments,
+              residual)
+        else:
+            outs = sync_region(
+                state.params, batch["inputs"], batch["labels"], segments,
+                residual,
+            )
+        return outs
+
     def step_fn(state, batch):
         from pyrecover_tpu.parallel.pipeline import pipeline_axis_size
 
@@ -304,7 +529,12 @@ def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0,
         use_1f1b = (
             model_config.pp_schedule == "1f1b" and pipeline_axis_size() > 1
         )
-        if use_1f1b:
+        new_residual = state.grad_residual
+        if use_quant:
+            grads, loss, n_valid, moe_aux, new_residual = _quantized_grads(
+                state, batch, segments
+            )
+        elif use_1f1b:
             loss, n_valid, moe_aux, grads = _pipelined_1f1b_value_and_grad(
                 state.params, batch, model_config, loss_chunk_size
             )
@@ -373,6 +603,10 @@ def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0,
             if model_config.n_experts > 0:
                 loss = obj - model_config.moe_aux_weight * moe_aux
 
+        # zero1's decomposed update lives INSIDE the optimizer chain
+        # (optim.zero1_wrap, placed after global-norm clipping so the norm
+        # reduction keeps the unsharded shape — the bit-exactness anchor);
+        # nothing to do here beyond the wiring check in make_train_step
         updates, new_opt_state = optimizer.update(
             grads, state.opt_state, state.params
         )
@@ -387,6 +621,7 @@ def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0,
             step=state.step + 1,
             epoch=state.epoch,
             rng=new_rng,
+            grad_residual=new_residual,
         )
         metrics = {
             "loss": loss,  # CE only — comparable to the reference's loss CSV

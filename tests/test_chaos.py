@@ -395,4 +395,12 @@ def test_chaos_smoke_soak_bitexact(tmp_path):
     assert el["bitexact_rows"] >= 1
     assert el["max_rel_diff"] <= el["rtol"]
     assert el["doctor_classification"] == "healthy"
+    # ISSUE 10 zero1 flag-flip drill: a zero1 run killed mid-training
+    # resumes with --optimizer-sharding none and the stitched CSV stays
+    # BIT-EXACT vs the zero1 golden (the convergence-parity contract),
+    # with the spec-drifted checkpoint restored — never quarantined
+    z1 = report["zero1"]
+    assert z1["continuity_ok"] and z1["bitexact"]
+    assert z1["resumes"] >= 1
+    assert z1["quarantined"] == []
     assert (tmp_path / "report.json").exists()

@@ -222,3 +222,56 @@ def test_model_level_flash_matches_sdpa():
     np.testing.assert_allclose(
         np.asarray(logits_flash), np.asarray(logits_sdpa), rtol=2e-4, atol=2e-4
     )
+
+
+def test_default_blocks_table():
+    """Pin the per-device-kind default tilings (fed by
+    tools/bench_flash_blocks.py sweeps): every known generation has a
+    row, the v5e row is the measured r03 sweep winner, resolution is
+    substring-based against the jax device_kind string, and an unknown
+    kind gets the conservative pre-table fallback."""
+    from pyrecover_tpu.ops.flash_attention import (
+        _FALLBACK_BLOCKS,
+        DEFAULT_BLOCKS,
+        default_blocks,
+    )
+
+    assert DEFAULT_BLOCKS == {
+        "v3": (256, 512),
+        "v4": (512, 1024),
+        "v5e": (1024, 1024),
+        "v5litepod": (1024, 1024),
+        "v5 lite": (1024, 1024),
+        "v5p": (1024, 1024),
+        "v6e": (1024, 2048),
+        "cpu": (512, 512),
+    }
+    assert _FALLBACK_BLOCKS == (1024, 1024)
+    # jax-style device_kind strings resolve by substring, case-insensitive
+    assert default_blocks("TPU v5e") == (1024, 1024)
+    assert default_blocks("TPU v5 lite") == (1024, 1024)
+    assert default_blocks("TPU v6e") == (1024, 2048)
+    assert default_blocks("warp-drive-9000") == _FALLBACK_BLOCKS
+    # the local (virtual CPU) device resolves through the cpu row
+    assert default_blocks() == (512, 512)
+
+
+def test_attention_fn_consumes_default_blocks(monkeypatch):
+    """ModelConfig.flash_block_q/kv == 0 (the default) resolves through
+    the defaults table at attention-builder time; an explicit axis wins
+    while the other still auto-resolves."""
+    from functools import partial as _partial
+
+    import pyrecover_tpu.models.llama as llama_mod
+    from pyrecover_tpu.models import ModelConfig
+
+    cfg = ModelConfig(attention_impl="flash")
+    fn = llama_mod._attention_fn(cfg)
+    assert isinstance(fn, _partial)
+    assert (fn.keywords["block_q"], fn.keywords["block_kv"]) == (512, 512)
+
+    cfg = ModelConfig(
+        attention_impl="flash", flash_block_q=2048, flash_block_kv=0
+    )
+    fn = llama_mod._attention_fn(cfg)
+    assert (fn.keywords["block_q"], fn.keywords["block_kv"]) == (2048, 512)

@@ -5,10 +5,13 @@ grid parallelism; the right point depends on head_dim, sequence length and
 the chip generation, and nothing but a measurement decides it (the round-3
 default 1024x1024 was picked on first principles, never swept). This sweeps
 the fwd+bwd attention op alone at the flagship bench point's shapes and
-prints per-config times plus the argmin, so the model default
-(``ModelConfig.flash_block_q/kv``, models/llama.py) can be set from
-evidence; ``bench.py --flash-block-q/--flash-block-kv`` then validates the
-winner end-to-end before it becomes the default.
+prints per-config times plus the argmin. The winner feeds the
+PER-DEVICE-KIND defaults table (``ops/flash_attention.py::DEFAULT_BLOCKS``,
+consumed whenever ``ModelConfig.flash_block_q/kv`` is 0 = auto and pinned
+by ``tests/test_flash_attention.py::test_default_blocks_table``):
+re-run the sweep on new hardware, update that row, update the pin.
+``bench.py --flash-block-q/--flash-block-kv`` validates a candidate
+end-to-end before it becomes the row.
 
 Prints ONE JSON line:
   {"metric": "flash_block_sweep", "value": <best ms>, "unit": "ms fwd+bwd",
