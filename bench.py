@@ -119,9 +119,14 @@ def main():
     ap.add_argument("--no-remat", action="store_true",
                     help="disable block rematerialization (more HBM, fewer FLOPs)")
     ap.add_argument("--remat-policy", default="full",
-                    choices=["full", "save-attn"],
-                    help="remat policy: full recompute, or keep attention "
-                         "outputs (skips recomputing the attention sublayer)")
+                    choices=["full", "save-attn", "auto"],
+                    help="remat policy: full recompute, keep attention "
+                         "outputs (skips recomputing the attention "
+                         "sublayer), or auto — size the policy "
+                         "(none/save-attn/full + a per-chip batch "
+                         "suggestion) against the shardcheck HBM model "
+                         "for the live device kind (utils/remat.py; "
+                         "overrides --no-remat)")
     ap.add_argument("--flash-block-q", type=int, default=0,
                     help="flash-attention q tile; 0 = the per-device-kind "
                          "default (ops/flash_attention.py DEFAULT_BLOCKS, "
@@ -141,6 +146,13 @@ def main():
                     help="gradient-sync wire format for the timed loop "
                          "(int8 = block-scaled quantized collectives with "
                          "error feedback)")
+    ap.add_argument("--grad-bucket-mb", type=float, default=0,
+                    help="latency-hidden gradients for the timed loop: "
+                         "bucket the gradient sync at this MiB cap "
+                         "(reverse-autodiff order, one collective per "
+                         "bucket) so XLA overlaps wire time with the "
+                         "remaining backward; extra.overlap records the "
+                         "layout + modelled exposed-vs-hidden comm")
     ap.add_argument("--write-ckpt-baseline", default=None,
                     help="write a traceview-format checkpoint-phase "
                          "baseline JSON ({phase_key: p50_s}) from this "
@@ -212,6 +224,25 @@ def main():
         flash_block_kv=args.flash_block_kv, remat_policy=args.remat_policy,
         moe_dispatch=args.moe_dispatch,
     )
+    # --remat-policy auto: size the policy (and a per-chip batch
+    # suggestion) against the SC05 HBM model BEFORE anything builds the
+    # model — the ROADMAP "spend the zero1 headroom" lever, measured
+    remat_decision = None
+    if args.remat_policy == "auto":
+        from pyrecover_tpu.utils.remat import resolve_remat_policy
+
+        remat_decision = resolve_remat_policy(
+            model_cfg, {"data": n_devices},
+            batch_size=args.batch_size, seq_len=args.seq_len,
+            loss_chunk_size=args.loss_chunk_size,
+            optimizer_sharding=args.optimizer_sharding,
+            grad_allreduce=args.grad_allreduce,
+            device_kind=jax.devices()[0].device_kind,
+        )
+        model_cfg = dataclasses.replace(
+            model_cfg, remat=remat_decision.remat,
+            remat_policy=remat_decision.remat_policy,
+        )
     train_cfg = TrainConfig(
         sequence_length=args.seq_len,
         batch_size=args.batch_size,
@@ -248,6 +279,7 @@ def main():
         grad_accumulation_steps=args.grad_accum,
         optimizer_sharding=args.optimizer_sharding,
         grad_allreduce=args.grad_allreduce,
+        grad_bucket_mb=args.grad_bucket_mb,
     )
 
     def sync(state):
@@ -420,6 +452,70 @@ def main():
     extra["bandwidth_lean"]["optimizer_hbm_reduction_pct"] = round(
         100.0 * (1 - hbm_zero1 / hbm_none), 2
     ) if hbm_none else 0.0
+
+    # ---- overlap: bucket layout + modelled exposed-vs-hidden comm ----------
+    # The layout the timed step actually ran with (live mesh), plus the
+    # dp8 projection every round carries so single-chip rounds still
+    # record the overlap delta a pod would see at this state size.
+    from pyrecover_tpu.analysis.shardcheck.collectives import overlap_model
+
+    overlap_live = overlap_model(
+        param_leaves, mesh_shape, grad_allreduce=args.grad_allreduce,
+        grad_bucket_mb=args.grad_bucket_mb,
+    )
+    overlap_dp8 = overlap_model(
+        param_leaves, ref_shape, grad_allreduce=args.grad_allreduce,
+        grad_bucket_mb=args.grad_bucket_mb,
+    )
+    extra["overlap"] = {
+        "bucket_mb": float(args.grad_bucket_mb),
+        "buckets": overlap_dp8["buckets"],
+        "per_bucket_wire_bytes_dp8": overlap_dp8["per_bucket_wire_bytes"],
+        "modelled_exposed_wire_bytes_dp8": overlap_dp8["exposed_wire_bytes"],
+        "modelled_hidden_wire_bytes_dp8": overlap_dp8["hidden_wire_bytes"],
+        "hidden_pct_dp8": overlap_dp8["hidden_pct"],
+        "live": overlap_live,
+        "modelled": True,
+    }
+    if remat_decision is not None:
+        extra["remat_auto"] = remat_decision.as_event()
+
+    # one-line overlap/remat summary (PR 10's wire-summary precedent):
+    # the run's effective bucket layout + remat sizing, visible without
+    # reading the jaxpr; stderr keeps the stdout contract at ONE JSON line
+    import sys as _sys
+
+    if overlap_dp8["buckets"]:
+        per = overlap_dp8["per_bucket_wire_bytes"]
+        ov_part = (
+            f"{overlap_dp8['buckets']} buckets @ "
+            f"{args.grad_bucket_mb:g} MiB "
+            f"(dp8 wire {min(per)/2**20:.1f}..{max(per)/2**20:.1f} MiB "
+            f"each, modelled hidden {overlap_dp8['hidden_pct']:.1f}%)"
+        )
+    elif args.grad_bucket_mb:
+        ov_part = (
+            f"bucket cap {args.grad_bucket_mb:g} MiB degenerate "
+            "(one bucket) — unbucketed"
+        )
+    else:
+        ov_part = "buckets off (single tail collective)"
+    if remat_decision is not None:
+        rm_part = (
+            f"remat auto -> {remat_decision.policy} "
+            f"(modelled {remat_decision.table[remat_decision.policy]/2**30:.2f}"
+            f" GiB/chip vs budget "
+            + (f"{remat_decision.budget_bytes/2**30:.1f} GiB"
+               if remat_decision.budget_bytes else "unknown")
+            + f", suggested per-chip batch "
+              f"{remat_decision.suggested_batch_per_chip})"
+        )
+    else:
+        rm_part = (
+            f"remat {args.remat_policy}"
+            if not args.no_remat else "remat off"
+        )
+    print(f"bench: overlap — {ov_part}; {rm_part}", file=_sys.stderr)
 
     if not args.skip_ckpt:
         # Checkpoint engine timing, component-split so the platform's wire

@@ -69,18 +69,21 @@ else
   rc=1
 fi
 
-# shardcheck bandwidth-lean gate: the zero1 + int8 update path must stay
-# wired end to end — the same 1/2/4/8-device mesh matrix with
-# --optimizer-sharding zero1 --grad-allreduce int8 re-resolves the state
-# specs per mesh (data-sharded moments, the int8 error-feedback residual),
-# traces the census (SC12 fires if the quantized sync collective ever
-# drops out of the step, or if zero1 stops sharding anything), and prices
-# the wire traffic against the fp32/none baseline in the JSON report.
+# shardcheck bandwidth-lean gate: the BUCKETED zero1 + int8 update path
+# must stay wired end to end — the same 1/2/4/8-device mesh matrix with
+# --optimizer-sharding zero1 --grad-allreduce int8 --grad-bucket-mb 64
+# re-resolves the state specs per mesh (data-sharded moments, the int8
+# error-feedback residual), traces the census (SC12 fires if the
+# quantized sync collective ever drops out of the step, or if zero1
+# stops sharding anything; SC13 fires if the per-bucket collectives
+# ever collapse back into one tail-of-backward blob), and prices the
+# wire traffic — per bucket, with the modelled exposed-vs-hidden split
+# — against the fp32/none baseline in the JSON report.
 if SHARDCHECK_Z1_OUT=$(JAX_PLATFORMS=cpu python tools/shardcheck.py \
     --preset llama-150m --strict \
-    --optimizer-sharding zero1 --grad-allreduce int8 \
+    --optimizer-sharding zero1 --grad-allreduce int8 --grad-bucket-mb 64 \
     --json "${SHARDCHECK_Z1_JSON:-/tmp/shardcheck_zero1_report.json}" 2>&1); then
-  echo "$SHARDCHECK_Z1_OUT" | tail -2   # clean: wire summary + count line
+  echo "$SHARDCHECK_Z1_OUT" | tail -3   # clean: wire + overlap + count line
 else
   echo "$SHARDCHECK_Z1_OUT"
   rc=1

@@ -48,6 +48,12 @@ CHECKS = {
              "quantized gradient collectives) but the traced step or the "
              "resolved specs still move/hold full-precision replicated "
              "state — the configuration is not actually wired in"),
+    "SC13": ("overlap-not-survived", "error",
+             "gradient bucketing is configured (--grad-bucket-mb) but the "
+             "traced step issues fewer data-axis gradient collectives "
+             "than the resolved bucket layout — the sync collapsed back "
+             "into a single tail-of-backward blob (or serialized behind "
+             "the full gradient materialization), so nothing overlaps"),
 }
 
 

@@ -81,6 +81,14 @@ def _build_parser():
         "traced step (default 256)",
     )
     p.add_argument(
+        "--grad-bucket-mb", type=float, default=0,
+        help="check the comm/compute overlap path: resolve the gradient "
+        "bucket layout at this MiB cap, assert the traced step issues "
+        "one data-axis collective per bucket (SC13 fires when the sync "
+        "collapsed back into a single tail collective), and price each "
+        "bucket's wire legs with the modelled exposed-vs-hidden split",
+    )
+    p.add_argument(
         "--diff-checkpoint", metavar="PATH", default=None,
         help="diff a saved checkpoint's schema manifest against the "
         "(single) --preset instead of running the mesh matrix",
@@ -198,6 +206,24 @@ def render_text(reports):
                 f"{_human(traffic['baseline']['bytes_on_wire_per_step'])}"
                 f" ({traffic['reduction_pct']:+.1f}% saved)"
             )
+        ov = (traffic or {}).get("overlap")
+        if ov:
+            if ov["buckets"]:
+                per = ov["per_bucket_wire_bytes"]
+                lines.append(
+                    f"  overlap @ {ov['bucket_mb']:g} MiB buckets: "
+                    f"{ov['buckets']} buckets "
+                    f"({_human(min(per))}..{_human(max(per))} wire each), "
+                    f"modelled hidden {_human(ov['hidden_wire_bytes'])} / "
+                    f"exposed {_human(ov['exposed_wire_bytes'])} "
+                    f"({ov['hidden_pct']:.1f}% hideable ceiling)"
+                )
+            else:
+                lines.append(
+                    f"  overlap @ {ov['bucket_mb']:g} MiB buckets: layout "
+                    "degenerate (one bucket) — unbucketed single "
+                    "collective, all wire exposed"
+                )
         for f in r["findings"]:
             lines.append("  " + _finding_line(f))
         total += len(r["findings"])
@@ -323,6 +349,7 @@ def main(argv=None):
             optimizer_sharding=args.optimizer_sharding,
             grad_allreduce=args.grad_allreduce,
             quant_block=args.grad_quant_block,
+            grad_bucket_mb=args.grad_bucket_mb,
         ))
 
     if args.json:

@@ -43,14 +43,18 @@ def _iter_sub_jaxprs(params):
                 yield cand.jaxpr
 
 
-def count_prims(jaxpr, counts=None, mult=1, gathers=None, wire_dtypes=None):
+def count_prims(jaxpr, counts=None, mult=1, gathers=None, wire_dtypes=None,
+                psum_payloads=None):
     """Recursive primitive census. Scan multiplies by its trip count, so
     a per-layer collective inside the layer scan counts n_layers times.
     ``gathers`` collects (shape, nbytes) of all_gather outputs for the
     full-param-gather check; ``wire_dtypes`` collects the output dtype
     strings of every all_to_all/all_gather — the quantized-sync evidence
     the SC12 wiring check reads (an int8 gradient sync shows int8
-    payloads on the exchange primitives)."""
+    payloads on the exchange primitives); ``psum_payloads`` collects the
+    element counts of NON-scalar psum outputs — the explicit fp32
+    gradient-bucket collectives the SC13 overlap check counts (the
+    step's own loss/count/aux psums are scalars and don't register)."""
     counts = {} if counts is None else counts
     for eqn in jaxpr.eqns:
         name = eqn.primitive.name
@@ -64,11 +68,21 @@ def count_prims(jaxpr, counts=None, mult=1, gathers=None, wire_dtypes=None):
                     gathers.append(tuple(aval.shape))
                 if wire_dtypes is not None:
                     wire_dtypes.append(str(aval.dtype))
+        if name == "psum" and psum_payloads is not None:
+            for var in eqn.outvars:
+                aval = getattr(var, "aval", None)
+                shape = getattr(aval, "shape", None)
+                if shape:  # rank >= 1: a flat gradient payload
+                    size = 1
+                    for d in shape:
+                        size *= int(d)
+                    psum_payloads.append(size)
         sub_mult = mult
         if name == "scan":
             sub_mult = mult * int(eqn.params.get("length", 1))
         for sub in _iter_sub_jaxprs(eqn.params):
-            count_prims(sub, counts, sub_mult, gathers, wire_dtypes)
+            count_prims(sub, counts, sub_mult, gathers, wire_dtypes,
+                        psum_payloads)
     return counts
 
 
@@ -85,11 +99,31 @@ def quantized_sync_missing(wire_dtypes, grad_allreduce, data_axis_size):
     return QUANT_WIRE_DTYPE[grad_allreduce] not in set(wire_dtypes or ())
 
 
+def overlap_missing(counts, psum_payloads, grad_allreduce, n_buckets,
+                    data_axis_size):
+    """True when gradient bucketing was CONFIGURED (a layout of
+    ``n_buckets`` >= 2 resolved) but the traced step issues fewer
+    data-axis gradient collectives than buckets — the SC13 condition:
+    the sync collapsed back into one tail-of-backward blob, so there is
+    nothing for XLA to overlap with the remaining backward.
+
+    Per-bucket evidence by wire mode: quantized syncs issue one
+    ``all_to_all`` (reduce-scatter leg) per bucket; fp32 buckets issue
+    one NON-scalar ``psum`` each (the step's loss/count psums are
+    scalars and don't count). Only judged when the data axis exists —
+    at size 1 no collective is expected at all."""
+    if n_buckets < 2 or data_axis_size <= 1:
+        return False
+    if grad_allreduce in QUANT_WIRE_DTYPE:
+        return (counts or {}).get("all_to_all", 0) < n_buckets
+    return len(psum_payloads or ()) < n_buckets
+
+
 def census(model_config, optimizer, batch_size, seq_len, *, mesh=None,
            loss_chunk_size=0, config=None, locus="config",
            param_leaves=None, param_specs=None,
            optimizer_sharding="none", grad_allreduce="fp32",
-           quant_block=256):
+           quant_block=256, grad_bucket_mb=0, traced_bucket_mb=None):
     """Trace one train step abstractly and return ``(table, findings)``.
 
     ``mesh``: a concrete Mesh to trace under (activates the sharding
@@ -102,7 +136,12 @@ def census(model_config, optimizer, batch_size, seq_len, *, mesh=None,
     bandwidth-lean configuration: the traced jaxpr then shows the
     EXPLICIT quantized sync collectives (int8/bf16 ``all_to_all`` +
     ``all_gather``), and their ABSENCE when configured is the SC12
-    wiring failure.
+    wiring failure. ``grad_bucket_mb`` additionally resolves the
+    overlap bucket layout and asserts the trace issues one data-axis
+    gradient collective per bucket (SC13 otherwise — the bucketed sync
+    collapsed back into a single tail collective). ``traced_bucket_mb``
+    overrides the value the traced step is BUILT with (test seam: the
+    SC13 misconfig is exactly "configured bucketed, traced fused").
     """
     from pyrecover_tpu.analysis.shardcheck.checks import DEFAULT_CONFIG
     from pyrecover_tpu.config import TrainConfig
@@ -134,12 +173,15 @@ def census(model_config, optimizer, batch_size, seq_len, *, mesh=None,
         loss_chunk_size=loss_chunk_size,
         optimizer_sharding=optimizer_sharding,
         grad_allreduce=grad_allreduce, grad_quant_block=quant_block,
+        grad_bucket_mb=(
+            grad_bucket_mb if traced_bucket_mb is None else traced_bucket_mb
+        ),
     )
     batch = {
         "inputs": jax.ShapeDtypeStruct((batch_size, seq_len), jnp.int32),
         "labels": jax.ShapeDtypeStruct((batch_size, seq_len), jnp.int32),
     }
-    counts, gathers, wire_dtypes = {}, [], []
+    counts, gathers, wire_dtypes, psum_payloads = {}, [], [], []
     try:
         if mesh is not None:
             with jax.sharding.set_mesh(mesh):
@@ -159,7 +201,22 @@ def census(model_config, optimizer, batch_size, seq_len, *, mesh=None,
                 f"{batch_size}, seq={seq_len}: {e}",
             )],
         )
-    count_prims(jaxpr.jaxpr, counts, 1, gathers, wire_dtypes)
+    count_prims(jaxpr.jaxpr, counts, 1, gathers, wire_dtypes, psum_payloads)
+
+    from pyrecover_tpu.parallel.collectives import (
+        param_leaf_order,
+        resolve_bucket_layout,
+    )
+
+    layout = resolve_bucket_layout(
+        [
+            int(np.prod(x.shape, dtype=np.int64)) if x.ndim else 1
+            for x in jax.tree_util.tree_leaves(abstract.params)
+        ],
+        grad_bucket_mb, max(data_n, 1), quant_block,
+        order=param_leaf_order(abstract.params),
+    ) if grad_bucket_mb else None
+    n_buckets = len(layout) if layout else 0
 
     table = {
         "traced": {
@@ -169,6 +226,8 @@ def census(model_config, optimizer, batch_size, seq_len, *, mesh=None,
         },
         "mesh_context": mesh is not None,
         "wire_dtypes": sorted(set(wire_dtypes)),
+        "grad_buckets": n_buckets,
+        "psum_vector_payloads": sorted(psum_payloads, reverse=True)[:64],
     }
     findings = []
     if quantized_sync_missing(wire_dtypes, grad_allreduce, data_n) and (
@@ -180,6 +239,21 @@ def census(model_config, optimizer, batch_size, seq_len, *, mesh=None,
             f"traced step shows no {QUANT_WIRE_DTYPE[grad_allreduce]} "
             "exchange collective — gradients would still move at full "
             "precision",
+        ))
+    if overlap_missing(counts, psum_payloads, grad_allreduce, n_buckets,
+                       data_n) and config.check_enabled("SC13"):
+        evidence = (
+            f"{counts.get('all_to_all', 0)} all_to_all"
+            if grad_allreduce in QUANT_WIRE_DTYPE
+            else f"{len(psum_payloads)} non-scalar psum"
+        )
+        findings.append(make_finding(
+            "SC13", locus,
+            f"--grad-bucket-mb {grad_bucket_mb} resolves to {n_buckets} "
+            f"gradient buckets but the traced step issues only "
+            f"{evidence} collective(s) on the data axis — the bucketed "
+            "sync collapsed into a single tail-of-backward collective; "
+            "no wire time overlaps the backward",
         ))
     if param_leaves is not None:
         big = {
@@ -230,9 +304,84 @@ def analytic_collectives(param_leaves, param_specs, mesh_shape):
     return out
 
 
+def overlap_model(param_leaves, mesh_shape, *, grad_allreduce="fp32",
+                  quant_block=256, grad_bucket_mb=0):
+    """Modelled exposed-vs-hidden communication for a bucket layout.
+
+    Idealized ceiling, stated as such: with the gradient sync split into
+    K buckets issued in reverse-autodiff order, buckets 0..K-2 have
+    remaining backward compute to hide behind (XLA's latency-hiding
+    scheduler starts each collective as soon as its leaves are final);
+    the LAST bucket — the first-computed gradients, final at the very
+    end of the backward — is the only reduction with nothing left to
+    overlap. Unbucketed, the whole sync is that exposed tail. Real
+    exposure depends on the compute:bandwidth ratio; this model bounds
+    what bucketing can possibly hide, per layout, so bench rounds can
+    compare layouts on equal terms.
+    """
+    import re
+
+    from pyrecover_tpu.parallel.collectives import (
+        grad_leaf_order,
+        resolve_bucket_layout,
+        wire_bytes_per_element,
+    )
+
+    n = int(mesh_shape.get("data", 1))
+    sizes, first_keys = [], []
+    elem_bytes_total = 0
+    for path, shape, dtype in param_leaves:
+        count = int(np.prod(shape, dtype=np.int64)) if len(shape) else 1
+        sizes.append(count)
+        elem_bytes_total += count * np.dtype(dtype).itemsize
+        # first bracketed key after .params: the top-level tree key the
+        # issue order ranks on (same permutation the jitted step uses)
+        m = re.search(r"\['([^']+)'\]", path or "")
+        first_keys.append(m.group(1) if m else "")
+    elems = sum(sizes)
+    bpe = wire_bytes_per_element(
+        grad_allreduce, quant_block,
+        elem_bytes=elem_bytes_total / max(elems, 1),
+    )
+
+    def two_legs(count):
+        # ring accounting per replica: (n-1)/n × payload per leg, 2 legs
+        return 2 * (n - 1) / n * count * bpe if n > 1 else 0.0
+
+    layout = resolve_bucket_layout(
+        sizes, grad_bucket_mb, max(n, 1), quant_block,
+        order=grad_leaf_order(first_keys),
+    ) if grad_bucket_mb else None
+    if layout is None:
+        total = int(round(two_legs(elems)))
+        return {
+            "modelled": True,
+            "bucket_mb": float(grad_bucket_mb or 0),
+            "buckets": 0,
+            "per_bucket_wire_bytes": [],
+            "total_wire_bytes": total,
+            "exposed_wire_bytes": total,  # one tail collective: all of it
+            "hidden_wire_bytes": 0,
+            "hidden_pct": 0.0,
+        }
+    per_bucket = [int(round(two_legs(b.n_elems))) for b in layout]
+    total = sum(per_bucket)
+    exposed = per_bucket[-1]  # the first-computed grads: nothing left to hide behind
+    return {
+        "modelled": True,
+        "bucket_mb": float(grad_bucket_mb),
+        "buckets": len(layout),
+        "per_bucket_wire_bytes": per_bucket,
+        "total_wire_bytes": total,
+        "exposed_wire_bytes": exposed,
+        "hidden_wire_bytes": total - exposed,
+        "hidden_pct": round(100.0 * (1 - exposed / total), 2) if total else 0.0,
+    }
+
+
 def traffic_model(param_leaves, mesh_shape, *, grad_allreduce="fp32",
                   optimizer_sharding="none", quant_block=256,
-                  grad_clipping=True):
+                  grad_clipping=True, grad_bucket_mb=0):
     """Per-step bytes-on-wire for the data-axis gradient sync: the
     CONFIGURED bandwidth-lean path vs the fp32/none baseline.
 
@@ -254,7 +403,11 @@ def traffic_model(param_leaves, mesh_shape, *, grad_allreduce="fp32",
 
     The zero1 win is measured in the memory table (optimizer bytes ÷
     data-axis size), not here; this model keeps the wire ledger honest
-    about that trade.
+    about that trade. ``grad_bucket_mb`` adds an ``overlap`` section
+    (:func:`overlap_model`): per-bucket wire bytes and the modelled
+    exposed-vs-hidden split for the configured layout — bucketing never
+    changes TOTAL bytes on the wire, only how much of the wire time has
+    backward compute left to hide behind.
     """
     n = int(mesh_shape.get("data", 1))
     elems = 0
@@ -285,10 +438,17 @@ def traffic_model(param_leaves, mesh_shape, *, grad_allreduce="fp32",
         legs["update_allgather"] = leg(grad_bytes)
     configured = int(round(sum(legs.values())))
     baseline = int(round(2 * leg(grad_bytes)))
+    overlap = None
+    if grad_bucket_mb:
+        overlap = overlap_model(
+            param_leaves, mesh_shape, grad_allreduce=grad_allreduce,
+            quant_block=quant_block, grad_bucket_mb=grad_bucket_mb,
+        )
     return {
         "modelled": True,
         "data_replicas": n,
         "grad_bytes_fp32": grad_bytes,
+        "overlap": overlap,
         "quant_block": int(quant_block) if grad_allreduce == "int8" else None,
         "baseline": {
             "mode": "fp32/none",

@@ -156,7 +156,7 @@ def check_preset(name, model_config, *, device_counts=(1, 2, 4, 8),
                  config=None, batch_size=None, seq_len=None,
                  run_census=True, mesh_configs=None,
                  optimizer_sharding="none", grad_allreduce="fp32",
-                 quant_block=256):
+                 quant_block=256, grad_bucket_mb=0):
     """Full preflight of one preset: spec matrix + memory + census.
 
     Returns a report dict (JSON-ready except the Finding objects under
@@ -169,6 +169,10 @@ def check_preset(name, model_config, *, device_counts=(1, 2, 4, 8),
     fires when a quantized sync is configured but absent from the trace,
     or when zero1 sharded nothing), and the report gains a ``traffic``
     section with the modelled bytes-on-wire vs the fp32/none baseline.
+    ``grad_bucket_mb`` checks the overlap path on top: the census
+    asserts one data-axis gradient collective per resolved bucket (SC13
+    otherwise) and the traffic section prices each bucket's legs with
+    the modelled exposed-vs-hidden split.
     """
     config = config or DEFAULT_CONFIG
     modes_active = optimizer_sharding != "none" or grad_allreduce != "fp32"
@@ -196,10 +200,11 @@ def check_preset(name, model_config, *, device_counts=(1, 2, 4, 8),
             mesh_configs if mesh_configs is not None
             else mesh_matrix(model_config, n)
         )
-        if grad_allreduce != "fp32":
-            # mirror the config-level composition rule: quantized
-            # gradient collectives launch on pure data-parallel replicas
-            # only (fsdp/tensor/expert/sequence/pipeline run their own
+        if grad_allreduce != "fp32" or grad_bucket_mb:
+            # mirror the config-level composition rule: the explicit
+            # gradient sync (quantized collectives and/or bucketed
+            # overlap) launches on pure data-parallel replicas only
+            # (fsdp/tensor/expert/sequence/pipeline run their own
             # collectives/manual regions) — checking unlaunchable meshes
             # would report findings no real run can hit
             matrix = [
@@ -276,6 +281,7 @@ def check_preset(name, model_config, *, device_counts=(1, 2, 4, 8),
         param_leaves, rep_shape,
         grad_allreduce=grad_allreduce,
         optimizer_sharding=optimizer_sharding, quant_block=quant_block,
+        grad_bucket_mb=grad_bucket_mb,
     )
     if run_census:
         n_dev = 1
@@ -295,6 +301,7 @@ def check_preset(name, model_config, *, device_counts=(1, 2, 4, 8),
             param_leaves=param_leaves, param_specs=param_specs,
             optimizer_sharding=optimizer_sharding,
             grad_allreduce=grad_allreduce, quant_block=quant_block,
+            grad_bucket_mb=grad_bucket_mb,
         )
         table["mesh"] = mesh_desc(rep_shape)
         table["analytic"] = analytic_collectives(

@@ -63,6 +63,14 @@ class TrainConfig:
     # carried in the train state; parallel/collectives.py)
     grad_allreduce: str = "fp32"  # fp32 | bf16 | int8
     grad_quant_block: int = 256  # int8 block size (one f32 scale per block)
+    # latency-hidden gradients: >0 partitions the flattened gradient
+    # pytree into fixed-byte buckets (reverse-autodiff order) and issues
+    # one data-axis collective per bucket, so XLA overlaps each bucket's
+    # wire time with the remaining backward compute. 0 = one tail-of-
+    # backward sync (the PR 10 form). Composes with fp32 (per-bucket
+    # psum, bit-exact vs unbucketed), bf16/int8 (per-bucket quantized
+    # legs + re-blocked error feedback), zero1 and grad accumulation.
+    grad_bucket_mb: float = 0.0
     training_steps: int = 1000
     seed: int = 42
     # -- model ---------------------------------------------------------------
@@ -164,22 +172,31 @@ class TrainConfig:
                 f"--grad-quant-block must be positive, got "
                 f"{self.grad_quant_block}"
             )
-        if self.grad_allreduce != "fp32":
-            # the quantized sync runs its own shard_map manual over the
+        if self.grad_bucket_mb < 0:
+            raise ValueError(
+                f"--grad-bucket-mb must be >= 0, got {self.grad_bucket_mb}"
+            )
+        if self.grad_allreduce != "fp32" or self.grad_bucket_mb > 0:
+            # the explicit gradient sync (quantized collectives and/or
+            # bucketed overlap) runs its own shard_map manual over the
             # data axis; schedules/axes with their OWN manual regions
             # would nest inside it — rejected loudly instead of tracing
             # into an unsupported composition
+            lean = (
+                f"--grad-allreduce {self.grad_allreduce}"
+                if self.grad_allreduce != "fp32" else "--grad-bucket-mb"
+            )
             if self.pp_schedule == "1f1b" or self.mesh.pipeline > 1:
                 raise ValueError(
-                    "--grad-allreduce bf16/int8 does not compose with "
-                    "pipeline parallelism (the pipeline schedule runs its "
-                    "own manual region); use --grad-allreduce fp32 with --pp"
+                    f"{lean} does not compose with pipeline parallelism "
+                    "(the pipeline schedule runs its own manual region); "
+                    "drop it with --pp"
                 )
             if self.mesh.sequence > 1:
                 raise ValueError(
-                    "--grad-allreduce bf16/int8 does not compose with "
-                    "sequence parallelism (ring attention runs its own "
-                    "manual region); use --grad-allreduce fp32 with --sp"
+                    f"{lean} does not compose with sequence parallelism "
+                    "(ring attention runs its own manual region); drop "
+                    "it with --sp"
                 )
             if (
                 self.mesh.fsdp > 1 or self.mesh.tensor > 1
@@ -190,10 +207,9 @@ class TrainConfig:
                 # partitioner weakness (hard CHECK failure, the same one
                 # models/moe.py and train_state._token_logprob document)
                 raise ValueError(
-                    "--grad-allreduce bf16/int8 supports pure data-"
-                    "parallel replicas (+zero1) only; fsdp/tensor/expert "
-                    "axes already shard their own collectives — use "
-                    "--grad-allreduce fp32 with them"
+                    f"{lean} supports pure data-parallel replicas "
+                    "(+zero1) only; fsdp/tensor/expert axes already "
+                    "shard their own collectives — drop it with them"
                 )
         # engine resolution: the explicit --checkpoint-engine wins; the
         # legacy --sharded-checkpoint boolean is kept in sync because the
@@ -301,6 +317,13 @@ def build_parser():
                    help="int8 quantization block size: one f32 scale per "
                         "this many gradient elements (default 256, ~1.6%% "
                         "wire overhead).")
+    p.add_argument("--grad-bucket-mb", type=float, default=d.grad_bucket_mb,
+                   help="latency-hidden gradients: partition the gradient "
+                        "pytree into buckets of this many MiB (reverse-"
+                        "autodiff order) and issue one data-axis collective "
+                        "per bucket, overlapping each bucket's wire time "
+                        "with the remaining backward compute. 0 = one "
+                        "tail-of-backward sync.")
     p.add_argument("--no-grad-clipping", action="store_true",
                    help="Disable gradient clipping (the reference's accidental default, train.py:272).")
     p.add_argument("--training-steps", type=int, default=d.training_steps)
@@ -337,10 +360,15 @@ def build_parser():
     p.add_argument("--remat", action="store_true",
                    help="Rematerialize transformer blocks (trade FLOPs for HBM).")
     p.add_argument("--remat-policy", type=str, default="full",
-                   choices=["full", "save-attn"],
+                   choices=["full", "save-attn", "auto"],
                    help="With --remat: recompute everything, or keep each "
                         "block's attention output (skips recomputing the "
-                        "attention sublayer in backward).")
+                        "attention sublayer in backward). 'auto' sizes the "
+                        "policy (none/save-attn/full) against the shardcheck "
+                        "HBM model for the live device kind at startup — "
+                        "ZeRO-1-freed headroom converts into the least "
+                        "recompute that fits (utils/remat.py; overrides "
+                        "--remat).")
     p.add_argument("--loss-chunk-size", type=int, default=0,
                    help=">0: compute the CE loss in sequence chunks of this size, "
                         "fusing the vocab projection (HBM saver for big vocabs).")
@@ -491,6 +519,7 @@ def get_args(argv=None):
         optimizer_sharding=ns.optimizer_sharding,
         grad_allreduce=ns.grad_allreduce,
         grad_quant_block=ns.grad_quant_block,
+        grad_bucket_mb=ns.grad_bucket_mb,
         grad_clipping=not ns.no_grad_clipping,
         training_steps=ns.training_steps,
         seed=ns.seed,

@@ -69,7 +69,10 @@ class ModelConfig:
     # remat policy when remat=True: "full" recomputes everything
     # (nothing_saveable); "save-attn" keeps each block's attention output
     # (one (B,S,D) tensor per layer) so the backward skips recomputing the
-    # whole attention sublayer — a little HBM for a chunk of the remat tax
+    # whole attention sublayer — a little HBM for a chunk of the remat tax.
+    # "auto" is resolved BEFORE the model is built (utils/remat.py sizes
+    # none/save-attn/full against the shardcheck HBM model); forward never
+    # sees it.
     remat_policy: str = "full"
     # flash-attention (block_q, block_kv) tiling; 0 = auto-resolve from
     # the per-device-kind defaults table (ops/flash_attention.py
@@ -92,10 +95,10 @@ class ModelConfig:
                 f"moe_top_k={self.moe_top_k} must be <= "
                 f"n_experts (--moe-experts) = {self.n_experts}"
             )
-        if self.remat_policy not in ("full", "save-attn"):
+        if self.remat_policy not in ("full", "save-attn", "auto"):
             raise ValueError(
-                f"remat_policy={self.remat_policy!r}: expected 'full' or "
-                "'save-attn'"
+                f"remat_policy={self.remat_policy!r}: expected 'full', "
+                "'save-attn' or 'auto'"
             )
         if self.pp_schedule not in ("gpipe", "1f1b"):
             raise ValueError(

@@ -152,7 +152,12 @@ def _ring_fwd_local(q, k, v, seg, *, axis_name, causal, scale, block_kv):
     sk, hkv = k.shape[1], k.shape[2]
     g = hq // hkv
     ring = jax.lax.axis_size(axis_name)
-    my = jax.lax.axis_index(axis_name)
+    # positions only feed the causal mask (segment masks compare ids, the
+    # ragged-tail mask uses local indices): without causality, skip
+    # axis_index entirely — its PartitionId lowering is what legacy XLA
+    # (jax 0.4.x) refuses to SPMD-partition, and a dead PartitionId used
+    # to make the whole non-causal ring a capability skip
+    my = jax.lax.axis_index(axis_name) if causal else 0
     q_start = my * sq
 
     qg = q.reshape(b, sq, hkv, g, d)
@@ -276,7 +281,9 @@ def _ring_bwd_local(q, k, v, seg, out, lse, do, *, axis_name, causal, scale,
     sk, hkv = k.shape[1], k.shape[2]
     g = hq // hkv
     ring = jax.lax.axis_size(axis_name)
-    my = jax.lax.axis_index(axis_name)
+    # same PartitionId-avoidance as the forward: positions are
+    # causal-mask-only inputs
+    my = jax.lax.axis_index(axis_name) if causal else 0
     q_start = my * sq
 
     qg = q.reshape(b, sq, hkv, g, d)

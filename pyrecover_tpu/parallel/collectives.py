@@ -34,7 +34,23 @@ and one ``all_gather`` — no host callbacks, no syncs; XLA fuses it into
 the step program, and the shardcheck census sees the quantized
 collectives at jaxpr level (the SC12 wiring check keys off exactly
 that).
+
+Bucketed comm/compute overlap (``--grad-bucket-mb``): instead of one
+tail-of-backward collective over the whole flattened gradient, the
+gradient leaves are partitioned into fixed-byte buckets in
+REVERSE-autodiff order (the backward pass finalizes the LAST layers'
+gradients first, so bucket 0 — output/final-norm/deep layers — is ready
+while most of the backward is still running) and each bucket's data-axis
+reduction is issued as its own collective. Each collective's operands
+depend only on that bucket's leaves, so XLA's latency-hiding scheduler
+is free to start the reduction as soon as those leaves are final and
+overlap the wire time with the remaining backward compute. The bucket
+layout is pure trace-time metadata (:func:`compute_bucket_layout`); the
+shardcheck census re-derives it and SC13 fires when a bucketed config's
+trace collapses back to a single fused tail collective.
 """
+
+import dataclasses
 
 import jax
 import jax.numpy as jnp
@@ -67,6 +83,133 @@ def padded_flat_len(param_count, replicas, block=DEFAULT_QUANT_BLOCK):
     in the train state uses the same formula — init and step must agree."""
     unit = max(int(replicas), 1) * int(block)
     return -(-int(param_count) // unit) * unit
+
+
+@dataclasses.dataclass(frozen=True)
+class GradBucket:
+    """One fixed-byte bucket of gradient leaves.
+
+    ``leaf_lo:leaf_hi`` indexes the ISSUE-ORDERED leaf list (see
+    :func:`grad_leaf_order`: bucket 0 holds the last-computed gradients
+    — the loss head — and its collective is issued first). ``offset``
+    is the bucket's element offset in the issue-ordered concat: the
+    index space the per-replica error-feedback residual uses. The issue
+    order is a pure function of the parameter STRUCTURE (never of the
+    cap), so the residual's shape and index space are identical across
+    bucket layouts — flipping ``--grad-bucket-mb`` across a resume is
+    spec-only drift, like zero1."""
+
+    index: int
+    leaf_lo: int
+    leaf_hi: int
+    n_elems: int
+    padded_len: int
+    offset: int
+
+    @property
+    def nbytes_f32(self):
+        return 4 * self.n_elems
+
+
+# forward stage of each top-level parameter-tree key: the backward
+# finalizes gradients in roughly REVERSE forward order (loss head first,
+# token embedding last — its cotangent is the backward's final product),
+# while canonical tree-flatten order is alphabetical and says nothing
+# about execution. Unknown keys rank with the layer stack.
+_FORWARD_STAGE = {"tok_embed": 0, "layers": 1, "final_norm": 2, "output": 3}
+
+
+def grad_leaf_order(first_keys):
+    """Reverse-autodiff issue order over gradient leaves.
+
+    ``first_keys``: each leaf's top-level parameter-tree key, in
+    canonical tree-flatten order. Returns a permutation of leaf indices:
+    the loss head (``output``, ``final_norm`` — final while most of the
+    backward is still running) first, the scanned layer stack next, the
+    embedding (final only at the very end of the backward) last; ties
+    keep reversed canonical order. Bucket 0 of a layout built on this
+    order is therefore ready earliest, so its collective has the most
+    backward compute left to hide behind.
+    """
+    first_keys = list(first_keys)
+    return sorted(
+        range(len(first_keys)),
+        key=lambda i: (_FORWARD_STAGE.get(first_keys[i], 1), i),
+        reverse=True,
+    )
+
+
+def compute_bucket_layout(leaf_sizes, bucket_bytes, replicas=1,
+                          block=DEFAULT_QUANT_BLOCK, order=None):
+    """Partition gradient leaves into fixed-byte buckets.
+
+    ``leaf_sizes``: per-leaf element counts in CANONICAL tree-flatten
+    order. ``order`` (a :func:`grad_leaf_order` permutation; default
+    plain reversed flatten order) is the issue order the layout walks,
+    greedily packing consecutive leaves until the next leaf would push
+    the bucket past ``bucket_bytes`` (f32 wire accounting: 4 bytes per
+    element — the flat gradient vector is f32 regardless of leaf
+    dtype). A single leaf larger than the cap becomes its own oversized
+    bucket — leaves are never split, so every leaf lands in exactly one
+    bucket. Each bucket's ``padded_len`` rounds up to a multiple of
+    ``replicas × block`` so the two-leg quantized collective chunks it
+    evenly.
+    """
+    if bucket_bytes <= 0:
+        raise ValueError(f"bucket_bytes must be positive, got {bucket_bytes}")
+    if order is None:
+        order = list(range(len(list(leaf_sizes))))[::-1]
+    sizes_all = [int(s) for s in leaf_sizes]
+    sizes = [sizes_all[j] for j in order]
+    unit = max(int(replicas), 1) * int(block)
+    buckets, lo, cur = [], 0, 0
+    offset = 0
+
+    def close(hi):
+        nonlocal lo, cur, offset
+        n = sum(sizes[lo:hi])
+        buckets.append(GradBucket(
+            index=len(buckets), leaf_lo=lo, leaf_hi=hi, n_elems=n,
+            padded_len=-(-n // unit) * unit, offset=offset,
+        ))
+        offset += n
+        lo, cur = hi, 0
+
+    for i, n in enumerate(sizes):
+        if cur and (cur + n) * 4 > bucket_bytes:
+            close(i)
+        cur += n
+        if cur * 4 > bucket_bytes:
+            close(i + 1)  # oversized single leaf (or the closing straw)
+    if cur or lo < len(sizes):
+        close(len(sizes))
+    return buckets
+
+
+def resolve_bucket_layout(leaf_sizes, bucket_mb, replicas=1,
+                          block=DEFAULT_QUANT_BLOCK, order=None):
+    """Bucket layout for a ``--grad-bucket-mb`` setting, or None when
+    bucketing is off (``bucket_mb <= 0``) or degenerate (the cap admits
+    every leaf into one bucket ≡ the unbucketed path — the step then
+    keeps the single-collective form, bit-for-bit the PR 10 behavior)."""
+    if not bucket_mb or bucket_mb <= 0:
+        return None
+    layout = compute_bucket_layout(
+        leaf_sizes, int(bucket_mb * 2**20), replicas, block, order=order
+    )
+    return layout if len(layout) > 1 else None
+
+
+def param_leaf_order(params):
+    """:func:`grad_leaf_order` over a live/abstract parameter pytree:
+    the issue-order permutation every bucket consumer (the jitted step,
+    the shardcheck census, the telemetry record, bench's overlap model)
+    must agree on."""
+    path_leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    return grad_leaf_order([
+        str(getattr(p[0], "key", getattr(p[0], "name", "")))
+        for p, _ in path_leaves
+    ])
 
 
 def flatten_grads(grads, padded_len):
@@ -144,8 +287,14 @@ def quantized_psum_flat(x, *, mode, block=DEFAULT_QUANT_BLOCK,
     the true sum — its leg-1 error over the full vector plus the leg-2
     requantization error of the chunk it owns — such that ``sum_r
     (reduced + deficit_r) == sum_r x_r`` exactly. ``deficit`` is None in
-    bf16 mode (no feedback, by design — the ablation baseline).
+    bf16 mode (no feedback, by design — the ablation baseline) and in
+    fp32 mode (one explicit ``psum`` — an exact elementwise sum, which
+    is why bucketed fp32 is bit-exact across ANY bucket layout: the
+    grouping changes which collective carries an element, never the
+    arithmetic that reduces it).
     """
+    if mode == "fp32":
+        return jax.lax.psum(x, axis_name), None
     n = jax.lax.axis_size(axis_name)
     L = x.shape[0]
     chunk = L // n
@@ -190,6 +339,8 @@ def quantized_roundtrip_local(x, *, mode, block=DEFAULT_QUANT_BLOCK):
     no wire, but the SAME quantize/dequantize numerics and error-feedback
     contract, so a 1-device run behaves like the n-replica path's n=1
     case (and the parity tests exercise identical math)."""
+    if mode == "fp32":
+        return x, None
     _, deq = _quantize_leg(x[None, :], mode, block)
     reduced = deq[0]
     if mode == "bf16":

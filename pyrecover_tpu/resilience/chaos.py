@@ -45,6 +45,19 @@ A smoke soak is four trainer runs over one experiment directory::
                zero1 golden BIT-EXACTLY (zero1 is semantically the
                replicated update) and the spec-drifted checkpoint
                restoring without quarantine
+    cycles 19-24: gradient-bucket flag-flip drills (own exp dirs, 2-device
+               mesh). (a) int8+buckets: a bucketed-int8 golden, a
+               bucketed-int8 run SIGTERM'd at s1, then a resume with
+               buckets OFF — the residual's layout-independent shape
+               must restore cleanly (no quarantine) and the stitched
+               CSV must track the golden bit-exactly before the flip
+               and within tolerance after (re-blocked quantization
+               groups change the low bits, never the trajectory).
+               (b) fp32 layout flip: a bucketed-fp32 golden, a kill at
+               s1, then a resume with a DIFFERENT bucket cap — gated
+               BIT-EXACT end to end: a per-bucket fp32 psum is an exact
+               elementwise sum, so the bucket layout can change across
+               a resume without touching the trajectory at all.
 
 Verdicts: per-cycle exit codes, stitched CSV == golden CSV, exactly the
 injected corruption quarantined (zero non-injected losses), and the
@@ -191,22 +204,25 @@ ELASTIC_RTOL = 0.05
 
 
 def _elastic_continuity(golden_rows, rows, steps, shrink_step,
-                        rtol=ELASTIC_RTOL):
-    """Gate the elastic drill's stitched loss CSV against its same-seed
-    4-device golden: bit-exact through the last step before the topology
-    first changed, within ``rtol`` relative after it, exact step sequence
-    throughout. Returns ``(info, violations)``."""
+                        rtol=ELASTIC_RTOL, label="elastic drill"):
+    """Gate a drill's stitched loss CSV against its same-seed golden:
+    bit-exact through the last step before the configuration first
+    changed (``shrink_step``), within ``rtol`` relative after it, exact
+    step sequence throughout. Returns ``(info, violations)``. Shared by
+    the elastic topology drill and the bucket flag-flip drill — both
+    change a trajectory-preserving knob mid-run and owe the same
+    exact-then-tolerance continuity shape."""
     violations = []
     info = {"rows": len(rows), "bitexact_rows": 0, "max_rel_diff": 0.0,
             "shrink_step": shrink_step, "rtol": rtol}
     if len(rows) != steps + 1 or len(golden_rows) != steps + 1:
         violations.append(
-            f"elastic drill: {len(rows)} stitched rows vs "
+            f"{label}: {len(rows)} stitched rows vs "
             f"{len(golden_rows)} golden (want {steps + 1})"
         )
         return info, violations
     if rows[0] != golden_rows[0]:
-        violations.append("elastic drill: CSV headers differ")
+        violations.append(f"{label}: CSV headers differ")
         return info, violations
     for i, (g, r) in enumerate(zip(golden_rows[1:], rows[1:]), start=1):
         try:
@@ -215,21 +231,21 @@ def _elastic_continuity(golden_rows, rows, steps, shrink_step,
             gs, rs, gl, rl = int(gs), int(rs), float(gl), float(rl)
         except ValueError:
             violations.append(
-                f"elastic drill: unparseable CSV row {i}: {g!r} vs {r!r}"
+                f"{label}: unparseable CSV row {i}: {g!r} vs {r!r}"
             )
             return info, violations
         if gs != i or rs != i:
             violations.append(
-                f"elastic drill: step sequence broken at row {i}: "
+                f"{label}: step sequence broken at row {i}: "
                 f"golden step {gs}, stitched step {rs}"
             )
             return info, violations
         if i <= shrink_step:
-            # same topology, same seed, deterministic CPU: any drift here
-            # means the resume machinery, not float noise
+            # same configuration, same seed, deterministic CPU: any
+            # drift here means the resume machinery, not float noise
             if g != r:
                 violations.append(
-                    f"elastic drill: pre-shrink row {i} not bit-exact: "
+                    f"{label}: pre-flip row {i} not bit-exact: "
                     f"{g!r} vs {r!r}"
                 )
                 return info, violations
@@ -239,7 +255,7 @@ def _elastic_continuity(golden_rows, rows, steps, shrink_step,
             info["max_rel_diff"] = max(info["max_rel_diff"], rel)
             if rel > rtol:
                 violations.append(
-                    f"elastic drill: loss diverged at step {i}: golden "
+                    f"{label}: loss diverged at step {i}: golden "
                     f"{gl} vs stitched {rl} (rel {rel:.5f} > {rtol})"
                 )
                 return info, violations
@@ -404,6 +420,39 @@ def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
           })
     cycle("z1_flip_resume@none", resume=True, expect_rc=(0,), exp="z1",
           device_count=2, fault_plan=None)
+
+    # cycles 19-24 — gradient-bucket flag-flip drills (own exp dirs,
+    # 2-device mesh so the data axis — and the per-bucket collectives —
+    # are real). (a) int8+buckets killed at s1, resumed with buckets
+    # OFF: the error-feedback residual's shape is layout-independent,
+    # so the flip is spec-only drift and must restore without
+    # quarantine; the post-flip curve re-blocks the quantization
+    # groups, so the gate is bit-exact-then-tolerance (like elastic).
+    # (b) bucketed fp32 killed at s1, resumed with a DIFFERENT bucket
+    # cap: per-bucket fp32 psums are exact elementwise sums, so the
+    # whole stitched curve must match the bucketed golden BIT-EXACTLY.
+    bk_args = ("--grad-allreduce", "int8", "--grad-bucket-mb", "0.05")
+    cycle("bk_golden", resume=False, expect_rc=(0,), exp="bk_golden",
+          fault_plan=None, extra_args=bk_args, device_count=2)
+    cycle("bk_kill@int8+buckets", resume=False, expect_rc=(0,), exp="bk",
+          device_count=2, extra_args=bk_args, fault_plan={
+              "seed": seed,
+              "faults": [{"type": "sigterm_at_step", "step": s1}],
+          })
+    cycle("bk_flip_resume@nobuckets", resume=True, expect_rc=(0,),
+          exp="bk", device_count=2,
+          extra_args=("--grad-allreduce", "int8"), fault_plan=None)
+    bkf_args = ("--grad-bucket-mb", "0.05")
+    cycle("bkf_golden", resume=False, expect_rc=(0,), exp="bkf_golden",
+          fault_plan=None, extra_args=bkf_args, device_count=2)
+    cycle("bkf_kill@fp32+buckets", resume=False, expect_rc=(0,), exp="bkf",
+          device_count=2, extra_args=bkf_args, fault_plan={
+              "seed": seed,
+              "faults": [{"type": "sigterm_at_step", "step": s1}],
+          })
+    cycle("bkf_flip_resume@newlayout", resume=True, expect_rc=(0,),
+          exp="bkf", device_count=2,
+          extra_args=("--grad-bucket-mb", "0.2"), fault_plan=None)
 
     exp_dir = workdir / "chaos"
     golden_rows = _read_csv_rows(
@@ -655,6 +704,85 @@ def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
         "resumes": sum(1 for e in z1_events if e["event"] == "resume"),
     }
 
+    # bucket flag-flip drill verdicts. (a) int8: bit-exact before the
+    # flip, tolerance after (the re-blocked quantization groups change
+    # low bits), residual restores without quarantine, the grad_bucket
+    # telemetry record shows the bucketed layout. (b) fp32 layout flip:
+    # BIT-EXACT stitched CSV against the bucketed golden end to end.
+    bk_dir = workdir / "bk"
+    bk_info, bk_viol = _elastic_continuity(
+        _read_csv_rows(workdir / "bk_golden" / "bk_golden_loss_log.csv"),
+        _read_csv_rows(bk_dir / "bk_loss_log.csv"),
+        steps, s1, label="bucket drill (int8)",
+    )
+    violations += bk_viol
+    if not (bk_dir / "DONE").exists():
+        violations.append(
+            "bucket drill (int8): no DONE marker after the flip resume"
+        )
+    bk_quarantined = [p.name for p in list_quarantined(bk_dir)]
+    if bk_quarantined:
+        violations.append(
+            "bucket drill (int8): the buckets-off flip must restore the "
+            f"bucketed-int8 checkpoint intact, but {bk_quarantined} got "
+            "quarantined"
+        )
+    bk_events = read_events(bk_dir / "bk_telemetry.jsonl")
+    if not any(e["event"] == "resume" for e in bk_events):
+        violations.append("bucket drill (int8): no resume event")
+    bk_buckets = [e for e in bk_events if e["event"] == "grad_bucket"]
+    if not any(e.get("buckets", 0) >= 2 for e in bk_buckets):
+        violations.append(
+            "bucket drill (int8): no grad_bucket record with a real "
+            "(>= 2 bucket) layout — the drill never ran bucketed"
+        )
+    bk_info["quarantined"] = bk_quarantined
+    bk_info["grad_bucket_events"] = len(bk_buckets)
+
+    bkf_dir = workdir / "bkf"
+    bkf_golden_rows = _read_csv_rows(
+        workdir / "bkf_golden" / "bkf_golden_loss_log.csv"
+    )
+    bkf_rows = _read_csv_rows(bkf_dir / "bkf_loss_log.csv")
+    bkf_divergence = None
+    for i, (a, b) in enumerate(zip(bkf_golden_rows, bkf_rows)):
+        if a != b:
+            bkf_divergence = {"row": i, "golden": a, "stitched": b}
+            break
+    bkf_continuity = (
+        bkf_divergence is None
+        and len(bkf_rows) == len(bkf_golden_rows) == steps + 1
+    )
+    if not bkf_continuity:
+        violations.append(
+            "bucket drill (fp32): layout-flip loss continuity broken "
+            "(per-bucket fp32 psums are exact sums — any drift is a "
+            "bug): "
+            + (json.dumps(bkf_divergence) if bkf_divergence else
+               f"{len(bkf_rows)} stitched rows vs {len(bkf_golden_rows)} "
+               f"golden (want {steps + 1})")
+        )
+    if not (bkf_dir / "DONE").exists():
+        violations.append(
+            "bucket drill (fp32): no DONE marker after the layout-flip "
+            "resume"
+        )
+    bkf_quarantined = [p.name for p in list_quarantined(bkf_dir)]
+    if bkf_quarantined:
+        violations.append(
+            "bucket drill (fp32): the layout flip must restore intact, "
+            f"but {bkf_quarantined} got quarantined"
+        )
+    bucket_info = {
+        "int8": bk_info,
+        "fp32_layout_flip": {
+            "rows": len(bkf_rows),
+            "bitexact": bkf_divergence is None,
+            "continuity_ok": bkf_continuity,
+            "quarantined": bkf_quarantined,
+        },
+    }
+
     zs_info = {
         "rows": len(zs_rows),
         "continuity_ok": zs_continuity,
@@ -693,6 +821,7 @@ def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
         "elastic": elastic_info,
         "zerostall": zs_info,
         "zero1": z1_info,
+        "bucket": bucket_info,
         "telemetry_rotated_shards": rotated,
         "telemetry_counts": {
             k: counts.get(k, 0)
@@ -756,6 +885,13 @@ def main(argv=None):
           f"{'bit-exact' if z1.get('bitexact') else 'DIVERGED'} "
           f"({z1.get('rows')} rows) | {z1.get('resumes')} resumes | "
           f"quarantined: {z1.get('quarantined')}")
+    bk = report.get("bucket") or {}
+    bki, bkf = bk.get("int8") or {}, bk.get("fp32_layout_flip") or {}
+    print(f"  bucket flag-flip: int8 {bki.get('bitexact_rows')} bit-exact "
+          f"rows then max rel {bki.get('max_rel_diff')} "
+          f"(tol {bki.get('rtol')}) | fp32 layout flip "
+          f"{'bit-exact' if bkf.get('bitexact') else 'DIVERGED'} "
+          f"({bkf.get('rows')} rows)")
     if report["violations"]:
         for v in report["violations"]:
             print(f"  VIOLATION: {v}")

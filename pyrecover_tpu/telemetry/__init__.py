@@ -68,6 +68,21 @@ Core event names across the stack (fields beyond the envelope):
                       on: the wire format the step was BUILT to move, with
                       the modelled per-leg bytes — shardcheck's traffic
                       model carries the full before/after ledger)
+    grad_bucket       bucket_mb, mode, buckets, degenerate,
+                      bucket_bytes_f32, min/max_bucket_bytes (once per
+                      run when --grad-bucket-mb is set: the resolved
+                      overlap bucket layout the jitted step issues —
+                      reverse-autodiff order, one data-axis collective
+                      per bucket; degenerate=True means the cap admitted
+                      everything into one bucket and the step kept the
+                      unbucketed single-collective form)
+    remat_autosize    policy, fits, device_kind, budget_bytes,
+                      table_bytes, batch_size, batch_per_chip,
+                      suggested_batch_size, suggested_batch_per_chip,
+                      suggested_total_bytes (once per run under
+                      --remat-policy auto: the policy utils/remat.py
+                      sized against the SC05 HBM model, with the
+                      per-chip batch the freed headroom could carry)
     preempt_check     step, time_left_s, threshold_s
     preempt_notice / preempt_stop / preempt_estimate
     preempt_signal_escalation  signal, count, step (2nd signal mid-save)

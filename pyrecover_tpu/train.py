@@ -680,6 +680,41 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
     )
 
     dataset, pad_token_id, model_config = build_dataset(config)
+
+    # ---- remat-policy autoscaling (--remat-policy auto) --------------------
+    # sized BEFORE anything builds the model: the SC05 memory model picks
+    # the least recompute that fits this device kind's HBM (utils/remat),
+    # so the headroom zero1 freed becomes throughput. The decision event
+    # is emitted once sinks are live (remat_decision stashed until then).
+    remat_decision = None
+    if model_config.remat_policy == "auto":
+        from pyrecover_tpu.utils.remat import resolve_remat_policy
+
+        remat_decision = resolve_remat_policy(
+            model_config,
+            {str(k): int(v) for k, v in dict(mesh.shape).items()},
+            batch_size=config.batch_size, seq_len=config.sequence_length,
+            loss_chunk_size=config.loss_chunk_size,
+            optimizer_sharding=config.optimizer_sharding,
+            grad_allreduce=config.grad_allreduce,
+            quant_block=config.grad_quant_block,
+            device_kind=jax.devices()[0].device_kind,
+        )
+        model_config = dataclasses.replace(
+            model_config, remat=remat_decision.remat,
+            remat_policy=remat_decision.remat_policy,
+        )
+        log_host0(
+            "remat auto: policy %s on %s (modelled %.2f GiB/device vs "
+            "budget %s; per-chip batch suggestion %d)",
+            remat_decision.policy,
+            remat_decision.device_kind or "<unknown device kind>",
+            remat_decision.table[remat_decision.policy] / 2**30,
+            (f"{remat_decision.budget_bytes / 2**30:.2f} GiB"
+             if remat_decision.budget_bytes else "unknown"),
+            remat_decision.suggested_batch_per_chip,
+        )
+
     sampler = StatefulSampler(
         dataset_len=len(dataset),
         global_batch_size=config.batch_size,
@@ -969,7 +1004,43 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
             optimizer_sharding=config.optimizer_sharding,
             grad_allreduce=config.grad_allreduce,
             grad_quant_block=config.grad_quant_block,
+            grad_bucket_mb=config.grad_bucket_mb,
         )
+        if remat_decision is not None:
+            telemetry.emit("remat_autosize", **remat_decision.as_event())
+        if config.grad_bucket_mb > 0:
+            # one host-side record of the overlap configuration: the
+            # bucket layout the step was built to issue (the same
+            # trace-time metadata the jitted step resolves), so the
+            # telemetry stream shows the effective layout without
+            # anyone reading the jaxpr
+            from pyrecover_tpu.parallel.collectives import (
+                param_leaf_order,
+                resolve_bucket_layout,
+            )
+
+            layout = resolve_bucket_layout(
+                [int(x.size) for x in
+                 jax.tree_util.tree_leaves(state.params)],
+                config.grad_bucket_mb,
+                int(dict(mesh.shape).get("data", 1)),
+                config.grad_quant_block,
+                order=param_leaf_order(state.params),
+            )
+            bucket_bytes = (
+                [b.nbytes_f32 for b in layout] if layout else []
+            )
+            telemetry.emit(
+                "grad_bucket",
+                bucket_mb=float(config.grad_bucket_mb),
+                mode=config.grad_allreduce,
+                buckets=len(bucket_bytes),
+                degenerate=layout is None,  # cap admitted one bucket:
+                # the step kept the unbucketed single-collective form
+                bucket_bytes_f32=bucket_bytes,
+                max_bucket_bytes=max(bucket_bytes, default=0),
+                min_bucket_bytes=min(bucket_bytes, default=0),
+            )
         if config.grad_allreduce != "fp32" or (
             config.optimizer_sharding != "none"
         ):

@@ -94,31 +94,41 @@ def _token_logprob(logprobs, safe_labels):
     return jnp.take_along_axis(logprobs, safe_labels[..., None], axis=-1)[..., 0]
 
 
-def masked_cross_entropy(logits, labels):
-    """Sum-reduced CE over non-masked tokens / count (reference train.py:263-266).
+def masked_ce_sum(logits, labels):
+    """UN-normalized sum-reduced CE over non-masked tokens.
 
-    Returns (loss, n_valid_tokens).
+    Returns (loss_sum, n_valid_tokens). The per-replica explicit-sync
+    objective needs the raw sum — reconstructing it from the mean
+    (``ce * n``) is a lossy float roundtrip that costs the bucketed-fp32
+    path its bit-exactness vs the implicit GSPMD allreduce.
     """
     valid = labels != IGNORE_INDEX
     safe_labels = jnp.where(valid, labels, 0)
     logprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     token_ll = _token_logprob(logprobs, safe_labels)
     loss_sum = -jnp.sum(jnp.where(valid, token_ll, 0.0))
-    n_valid = jnp.sum(valid)
+    return loss_sum, jnp.sum(valid)
+
+
+def masked_cross_entropy(logits, labels):
+    """Sum-reduced CE over non-masked tokens / count (reference train.py:263-266).
+
+    Returns (loss, n_valid_tokens).
+    """
+    loss_sum, n_valid = masked_ce_sum(logits, labels)
     return loss_sum / jnp.maximum(n_valid, 1).astype(jnp.float32), n_valid
 
 
-def chunked_ce(params, hidden, labels, model_config, chunk_size):
-    """Fused projection + CE over sequence chunks: never materializes the
-    full (batch, seq, vocab) logits — the dominant HBM cost of the naive
-    loss at LLM vocab sizes. ``lax.map`` over chunks keeps one chunk of
-    logits live at a time (in fwd AND in the scanned backward)."""
+def chunked_ce_sum(params, hidden, labels, model_config, chunk_size):
+    """UN-normalized twin of :func:`chunked_ce`: ``(loss_sum, n_valid)``
+    with no mean division — the exact per-replica partial the explicit
+    gradient sync's objective (``Σ CE / N_total``) is built from."""
     from pyrecover_tpu.models.llama import project_vocab
 
     b, s, d = hidden.shape
     if chunk_size <= 0 or s % chunk_size or s == chunk_size:
         logits = project_vocab(params, hidden, model_config)
-        return masked_cross_entropy(logits, labels)
+        return masked_ce_sum(logits, labels)
 
     n = s // chunk_size
     h_chunks = jnp.moveaxis(hidden.reshape(b, n, chunk_size, d), 1, 0)
@@ -139,8 +149,18 @@ def chunked_ce(params, hidden, labels, model_config, chunk_size):
         return -jnp.sum(jnp.where(valid, ll, 0.0)), jnp.sum(valid)
 
     sums, counts = jax.lax.map(per_chunk, (h_chunks, l_chunks))
-    n_valid = jnp.sum(counts)
-    return jnp.sum(sums) / jnp.maximum(n_valid, 1).astype(jnp.float32), n_valid
+    return jnp.sum(sums), jnp.sum(counts)
+
+
+def chunked_ce(params, hidden, labels, model_config, chunk_size):
+    """Fused projection + CE over sequence chunks: never materializes the
+    full (batch, seq, vocab) logits — the dominant HBM cost of the naive
+    loss at LLM vocab sizes. ``lax.map`` over chunks keeps one chunk of
+    logits live at a time (in fwd AND in the scanned backward)."""
+    loss_sum, n_valid = chunked_ce_sum(
+        params, hidden, labels, model_config, chunk_size
+    )
+    return loss_sum / jnp.maximum(n_valid, 1).astype(jnp.float32), n_valid
 
 
 def chunked_loss(params, tokens, labels, model_config, chunk_size):
@@ -271,7 +291,7 @@ def _pipelined_1f1b_value_and_grad(params, batch, model_config,
 def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0,
                     grad_accumulation_steps=1, optimizer_sharding="none",
                     grad_allreduce="fp32", grad_quant_block=None,
-                    grad_error_feedback=True):
+                    grad_error_feedback=True, grad_bucket_mb=0):
     """Build the jitted functional train step.
 
     state, batch → new_state, metrics. Under a mesh, batch/params shardings
@@ -307,6 +327,32 @@ def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0,
       bytes on both wire legs. Composes with pure DP, fsdp and tensor;
       the 1f1b pipeline schedule and sequence parallelism are rejected
       at config time (their own manual regions would nest).
+    * ``grad_bucket_mb > 0`` — latency-hidden gradients: the flattened
+      gradient pytree is partitioned into fixed-byte buckets in
+      reverse-autodiff order (parallel/collectives.py:
+      ``compute_bucket_layout``) and each bucket's data-axis reduction
+      is issued as its OWN collective, depending only on that bucket's
+      leaves — XLA's latency-hiding scheduler can start each reduction
+      as soon as its gradients are final and overlap the wire time with
+      the remaining backward compute. Composes with every wire mode
+      (fp32 buckets are explicit per-bucket ``psum``s; int8 re-blocks
+      the error-feedback residual per bucket with the residual SHAPE
+      unchanged, so flipping the flag across a resume is spec-only
+      drift), with zero1 (the update decomposition runs after the
+      sync), and with grad accumulation (buckets sync the accumulated
+      gradient once). A cap that admits everything into one bucket
+      resolves to the unbucketed path unchanged.
+
+      Numerics contract (test- and chaos-gated): a per-bucket fp32
+      ``psum`` is an exact elementwise sum, so bucketed fp32 is
+      BIT-EXACT across every bucket layout — resuming with a different
+      ``--grad-bucket-mb`` continues the identical trajectory. Against
+      the implicit-GSPMD fp32/no-bucket path (the untouched default)
+      the explicit sync is the same math but a different program form,
+      and XLA's per-op partitioning choices (contract-then-reduce vs
+      gather-then-contract) reassociate float sums — measured ~5e-4
+      relative loss drift over 20 tiny-model steps, the same noise
+      class as the elastic drill's topology change, tolerance-gated.
     """
     A = int(grad_accumulation_steps)
     if A < 1:
@@ -339,10 +385,16 @@ def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0,
         )
     use_quant = grad_allreduce != "fp32"
     quant_block = int(grad_quant_block or DEFAULT_QUANT_BLOCK)
-    if use_quant and model_config.pp_schedule == "1f1b":
+    bucket_mb = float(grad_bucket_mb or 0)
+    if bucket_mb < 0:
         raise ValueError(
-            "--grad-allreduce bf16/int8 composes with the gpipe schedule "
-            "only; the 1f1b pipeline runs its own manual region"
+            f"grad_bucket_mb must be >= 0, got {grad_bucket_mb}"
+        )
+    if (use_quant or bucket_mb > 0) and model_config.pp_schedule == "1f1b":
+        raise ValueError(
+            "--grad-allreduce bf16/int8 and --grad-bucket-mb compose with "
+            "the gpipe schedule only; the 1f1b pipeline runs its own "
+            "manual region"
         )
     if model_config.pp_schedule == "1f1b" and A > 1:
         raise ValueError(
@@ -387,8 +439,13 @@ def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0,
             hidden, moe_aux = forward_hidden_with_aux(
                 p, inp, model_config, segment_ids=sg
             )
-            ce, n = chunked_ce(p, hidden, lab, model_config, loss_chunk_size)
-            ce_sum = ce * jnp.maximum(n, 1).astype(jnp.float32)
+            # the RAW local CE sum (chunked_ce_sum): dividing by the local
+            # count and multiplying it back would be a lossy roundtrip —
+            # the objective Σ CE / N_total must see the exact partial for
+            # the explicit sync to match the GSPMD allreduce bit-for-bit
+            ce_sum, n = chunked_ce_sum(
+                p, hidden, lab, model_config, loss_chunk_size
+            )
             obj = ce_sum / n_total
             aux_rows = moe_aux * (inp.shape[0] / B)
             if model_config.n_experts > 0:
@@ -433,10 +490,14 @@ def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0,
         )
         return g, ce_sum, n_valid, aux
 
-    def _quantized_grads(state, batch, segments):
-        """Gradients with the quantized cross-replica sync: per-replica
+    def _quantized_grads(state, batch, segments, layout=None, order=None):
+        """Gradients with the explicit cross-replica sync: per-replica
         partials inside a data-manual shard_map, error-feedback
-        compensation (int8), quantized reduce-scatter + allgather legs.
+        compensation (int8), quantized reduce-scatter + allgather legs
+        (or a plain per-bucket ``psum`` in fp32 mode). ``layout`` (a
+        ``compute_bucket_layout`` result) splits the sync into one
+        collective per bucket in reverse-autodiff order — the overlap
+        path; None keeps the single-collective PR 10 form bit-for-bit.
         Returns ``(grads, loss, n_valid, moe_aux, new_residual)``."""
         from pyrecover_tpu.parallel.collectives import (
             flatten_grads,
@@ -458,6 +519,64 @@ def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0,
         pad_len = padded_flat_len(n_elems, data_n, quant_block)
         residual = state.grad_residual
 
+        def reduce_one(flat, manual):
+            if manual:
+                return quantized_psum_flat(
+                    flat, mode=grad_allreduce, block=quant_block,
+                    axis_name=AXIS_DATA,
+                )
+            return quantized_roundtrip_local(
+                flat, mode=grad_allreduce, block=quant_block
+            )
+
+        def sync_whole(g, res, manual, use_feedback):
+            """The PR 10 single-collective sync (layout is None)."""
+            flat, unflatten = flatten_grads(g, pad_len)
+            if use_feedback:
+                flat = flat + res[0]
+            reduced, deficit = reduce_one(flat, manual)
+            return unflatten(reduced), deficit
+
+        def sync_bucketed(g, res, manual, use_feedback):
+            """One collective per bucket, issued in reverse-autodiff
+            order (``order``, a grad_leaf_order permutation): bucket 0
+            — the loss head, final while most of the backward still
+            runs — goes out first, depending only on its own leaves;
+            the remaining backward compute is what hides its wire time.
+            Deficits are re-blocked per bucket but stored at each
+            bucket's element offset in one flat residual row, and the
+            issue order depends only on the parameter structure, so the
+            residual SHAPE and index space are layout-independent
+            (bucket flips across resumes are spec-only drift)."""
+            leaves, treedef = jax.tree_util.tree_flatten(g)
+            ordered = [leaves[j] for j in order]
+            out = [None] * len(leaves)
+            deficit_parts = []
+            for b in layout:
+                flat, unflatten = flatten_grads(
+                    ordered[b.leaf_lo:b.leaf_hi], b.padded_len
+                )
+                if use_feedback:
+                    part = res[0, b.offset:b.offset + b.n_elems]
+                    flat = flat.at[:b.n_elems].add(part)
+                reduced, deficit = reduce_one(flat, manual)
+                for j, leaf in enumerate(unflatten(reduced)):
+                    out[order[b.leaf_lo + j]] = leaf
+                if deficit is not None:
+                    # per-bucket padding coords quantize exactly (zero
+                    # blocks), so dropping their always-zero deficit
+                    # loses nothing
+                    deficit_parts.append(deficit[:b.n_elems])
+            g_red = jax.tree_util.tree_unflatten(treedef, out)
+            if not deficit_parts:
+                return g_red, None
+            row = jnp.concatenate(deficit_parts)
+            if row.shape[0] < pad_len:
+                row = jnp.concatenate(
+                    [row, jnp.zeros((pad_len - row.shape[0],), jnp.float32)]
+                )
+            return g_red, row
+
         def sync_region(params, inputs, labels, segs, res):
             from pyrecover_tpu.parallel.mesh import constraints_disabled
 
@@ -475,32 +594,25 @@ def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0,
                 g, ce_sum, n_valid, aux = _local_value_and_grad(
                     params, inputs, labels, segs, n_total, B
                 )
-            flat, unflatten = flatten_grads(g, pad_len)
             # error feedback: re-inject last step's deficit before
             # quantizing (grad_error_feedback=False is the test-only
             # ablation knob proving the mechanism matters)
             use_feedback = res is not None and grad_error_feedback
-            if use_feedback:
-                flat = flat + res[0]
+            if layout is None:
+                g_red, deficit = sync_whole(g, res, manual, use_feedback)
+            else:
+                g_red, deficit = sync_bucketed(g, res, manual, use_feedback)
             if manual:
-                reduced, deficit = quantized_psum_flat(
-                    flat, mode=grad_allreduce, block=quant_block,
-                    axis_name=AXIS_DATA,
-                )
                 ce_sum = jax.lax.psum(ce_sum, AXIS_DATA)
                 n_valid = jax.lax.psum(n_valid, AXIS_DATA)
                 aux = jax.lax.psum(aux, AXIS_DATA)
-            else:
-                reduced, deficit = quantized_roundtrip_local(
-                    flat, mode=grad_allreduce, block=quant_block
-                )
             if deficit is None or res is None:
-                new_res = res  # bf16 / no residual: nothing carried
+                new_res = res  # fp32/bf16 / no residual: nothing carried
             elif grad_error_feedback:
                 new_res = deficit[None, :]
             else:
                 new_res = res  # ablation: deficit computed, never fed back
-            return unflatten(reduced), ce_sum / n_total, n_valid, aux, new_res
+            return g_red, ce_sum / n_total, n_valid, aux, new_res
 
         if data_n > 1:
             from jax.sharding import PartitionSpec as P
@@ -523,16 +635,38 @@ def make_train_step(model_config, optimizer, donate=True, loss_chunk_size=0,
         return outs
 
     def step_fn(state, batch):
+        from pyrecover_tpu.parallel.collectives import (
+            param_leaf_order,
+            resolve_bucket_layout,
+        )
+        from pyrecover_tpu.parallel.mesh import AXIS_DATA
         from pyrecover_tpu.parallel.pipeline import pipeline_axis_size
 
         segments = batch.get("segments")  # packed-sequence ids or None
         use_1f1b = (
             model_config.pp_schedule == "1f1b" and pipeline_axis_size() > 1
         )
+        mesh = jax.sharding.get_abstract_mesh()
+        data_n = (
+            int(dict(mesh.shape).get(AXIS_DATA, 1))
+            if mesh is not None and not mesh.empty else 1
+        )
+        layout = order = None
+        if bucket_mb > 0:
+            order = param_leaf_order(state.params)
+            layout = resolve_bucket_layout(
+                [x.size for x in jax.tree_util.tree_leaves(state.params)],
+                bucket_mb, data_n, quant_block, order=order,
+            )
+        # fp32 without a real data axis has no wire to bucket — the
+        # implicit-GSPMD path stays the parity anchor there; quantized
+        # modes always take the explicit sync (their numerics ARE the
+        # explicit collective, mesh or not)
+        use_explicit = use_quant or (layout is not None and data_n > 1)
         new_residual = state.grad_residual
-        if use_quant:
+        if use_explicit:
             grads, loss, n_valid, moe_aux, new_residual = _quantized_grads(
-                state, batch, segments
+                state, batch, segments, layout, order
             )
         elif use_1f1b:
             loss, n_valid, moe_aux, grads = _pipelined_1f1b_value_and_grad(

@@ -1,0 +1,182 @@
+"""Remat-policy autoscaling: spend HBM headroom on less recompute.
+
+ZeRO-1 (PR 10) freed optimizer HBM; this module converts that headroom
+into throughput instead of letting it idle. ``--remat-policy auto``
+sizes the rematerialization policy against the SAME per-device memory
+model the shardcheck SC05 budget gate uses (analysis/shardcheck/checks
+.py:memory_budget — exact sharded params/optimizer bytes, labelled-
+coarse activations/logits), walking the policies from fastest to
+leanest and picking the FIRST one that fits:
+
+    none       remat off — every block activation saved, no recompute tax
+    save-attn  remat on, attention outputs kept — backward skips the
+               attention sublayer recompute
+    full       remat on, nothing saved — maximum recompute, minimum HBM
+
+It also suggests the largest per-chip batch the chosen policy still
+fits (doubling the global batch preserves mesh divisibility), so freed
+memory converts into larger steps, not headroom. Everything is pure
+metadata math — no devices are touched; the device kind comes from the
+caller (the live accelerator in train/bench, ``$PYRECOVER_DEVICE_KIND``
+as the test/CI override). An unknown device kind (CPU hosts, new
+hardware) resolves to ``none`` with ``fits=None``: there is no budget
+to size against, and the SC05 preflight stays the authority at launch.
+"""
+
+import dataclasses
+import os
+
+# (policy, ModelConfig.remat, ModelConfig.remat_policy) from fastest
+# backward to leanest HBM — resolution picks the first that fits
+REMAT_POLICIES = (
+    ("none", False, "full"),
+    ("save-attn", True, "save-attn"),
+    ("full", True, "full"),
+)
+
+DEVICE_KIND_ENV = "PYRECOVER_DEVICE_KIND"
+
+# batch-suggestion search bound: 8 doublings = 256x the configured batch
+_MAX_BATCH_DOUBLINGS = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class RematDecision:
+    """The resolved policy + the evidence it was sized on."""
+
+    policy: str  # none | save-attn | full
+    remat: bool  # ModelConfig.remat to build with
+    remat_policy: str  # ModelConfig.remat_policy to build with
+    fits: bool  # None = no budget to judge (unknown device kind)
+    device_kind: str
+    budget_bytes: int  # None when the device kind is unknown
+    hbm_fraction: float
+    table: dict  # policy -> modelled total bytes/device (the SC05 rows)
+    batch_size: int  # the configured GLOBAL batch
+    batch_per_chip: int
+    suggested_batch_size: int  # largest fitting GLOBAL batch, >= configured
+    suggested_batch_per_chip: int
+    suggested_total_bytes: int  # modelled bytes at the suggested batch
+
+    def as_event(self):
+        """Flat dict for the ``remat_autosize`` telemetry event."""
+        return {
+            "policy": self.policy,
+            "fits": self.fits,
+            "device_kind": self.device_kind,
+            "budget_bytes": self.budget_bytes,
+            "table_bytes": dict(self.table),
+            "batch_size": self.batch_size,
+            "batch_per_chip": self.batch_per_chip,
+            "suggested_batch_size": self.suggested_batch_size,
+            "suggested_batch_per_chip": self.suggested_batch_per_chip,
+            "suggested_total_bytes": self.suggested_total_bytes,
+        }
+
+
+def _with_policy(model_config, policy):
+    for name, remat, remat_policy in REMAT_POLICIES:
+        if name == policy:
+            return dataclasses.replace(
+                model_config, remat=remat, remat_policy=remat_policy
+            )
+    raise ValueError(f"unknown remat policy {policy!r}")
+
+
+def modelled_total_bytes(model_config, mesh_shape, *, batch_size, seq_len,
+                         policy, loss_chunk_size=0,
+                         optimizer_sharding="none", grad_allreduce="fp32",
+                         quant_block=256):
+    """Per-device HBM estimate for one remat policy — exactly the SC05
+    table (memory_budget), with the state leaves resolved in the
+    configured bandwidth-lean modes (zero1-sharded moments, the int8
+    residual) so the headroom zero1 freed is what gets spent."""
+    from pyrecover_tpu.analysis.shardcheck.checks import memory_budget
+    from pyrecover_tpu.analysis.shardcheck.runner import abstract_state_leaves
+
+    leaves, specs = abstract_state_leaves(
+        model_config, optimizer_sharding=optimizer_sharding,
+        grad_allreduce=grad_allreduce, quant_block=quant_block,
+        mesh_shape=mesh_shape,
+    )
+    rows, _ = memory_budget(
+        leaves, specs, mesh_shape, _with_policy(model_config, policy),
+        batch_size=batch_size, seq_len=seq_len,
+        loss_chunk_size=loss_chunk_size,
+    )
+    return int(rows["total_bytes"])
+
+
+def resolve_remat_policy(model_config, mesh_shape, *, batch_size, seq_len,
+                         loss_chunk_size=0, optimizer_sharding="none",
+                         grad_allreduce="fp32", quant_block=256,
+                         device_kind=None, hbm_fraction=0.9):
+    """Size ``--remat-policy auto`` against the SC05 HBM model.
+
+    Returns a :class:`RematDecision`. ``device_kind`` defaults to
+    ``$PYRECOVER_DEVICE_KIND``; callers pass the live accelerator's
+    kind. Policies are tried fastest-first (none, save-attn, full) and
+    the first fitting one wins; when nothing fits, ``full`` is chosen
+    (the leanest the model can run) with ``fits=False`` so the launch
+    preflight's SC05 still gets the last word.
+    """
+    from pyrecover_tpu.utils.perf import tpu_hbm_bytes
+
+    # env override WINS over the live device (the PR 7 elastic-preflight
+    # convention): a CPU test host can size against real TPU budgets
+    device_kind = os.environ.get(DEVICE_KIND_ENV) or device_kind or ""
+    capacity = tpu_hbm_bytes(device_kind) if device_kind else None
+    budget = int(capacity * hbm_fraction) if capacity else None
+
+    def total_at(policy, batch):
+        return modelled_total_bytes(
+            model_config, mesh_shape, batch_size=batch, seq_len=seq_len,
+            policy=policy, loss_chunk_size=loss_chunk_size,
+            optimizer_sharding=optimizer_sharding,
+            grad_allreduce=grad_allreduce, quant_block=quant_block,
+        )
+
+    table = {
+        policy: total_at(policy, batch_size)
+        for policy, _, _ in REMAT_POLICIES
+    }
+    batch_shards = max(
+        int(mesh_shape.get("data", 1)) * int(mesh_shape.get("fsdp", 1)), 1
+    )
+    per_chip = max(int(batch_size) // batch_shards, 1)
+
+    if budget is None:
+        # nothing to size against: no recompute, and no batch advice —
+        # the run (or SC05 with an explicit --device-kind) decides
+        chosen, fits = "none", None
+        suggested, suggested_bytes = int(batch_size), table["none"]
+    else:
+        chosen, fits = "full", False
+        for policy, _, _ in REMAT_POLICIES:
+            if table[policy] <= budget:
+                chosen, fits = policy, True
+                break
+        # spend what is left: largest doubling of the global batch the
+        # chosen policy still fits (doubling preserves mesh divisibility)
+        suggested, suggested_bytes = int(batch_size), table[chosen]
+        if fits:
+            batch = int(batch_size)
+            for _ in range(_MAX_BATCH_DOUBLINGS):
+                total = total_at(chosen, batch * 2)
+                if total > budget:
+                    break
+                batch *= 2
+                suggested, suggested_bytes = batch, total
+
+    _, remat, remat_policy = next(
+        entry for entry in REMAT_POLICIES if entry[0] == chosen
+    )
+    return RematDecision(
+        policy=chosen, remat=remat, remat_policy=remat_policy, fits=fits,
+        device_kind=device_kind, budget_bytes=budget,
+        hbm_fraction=hbm_fraction, table=table,
+        batch_size=int(batch_size), batch_per_chip=per_chip,
+        suggested_batch_size=suggested,
+        suggested_batch_per_chip=max(suggested // batch_shards, 1),
+        suggested_total_bytes=suggested_bytes,
+    )

@@ -403,4 +403,17 @@ def test_chaos_smoke_soak_bitexact(tmp_path):
     assert z1["continuity_ok"] and z1["bitexact"]
     assert z1["resumes"] >= 1
     assert z1["quarantined"] == []
+    # ISSUE 11 bucket flag-flip drills: a bucketed-int8 run killed
+    # mid-training resumes with buckets off (bit-exact to the flip,
+    # tolerance after — re-blocked quantization groups), and a bucketed
+    # fp32 run resumes with a DIFFERENT bucket cap BIT-EXACTLY
+    # (per-bucket psums are exact sums); neither flip quarantines
+    bk = report["bucket"]
+    assert bk["int8"]["bitexact_rows"] >= 1
+    assert bk["int8"]["max_rel_diff"] <= bk["int8"]["rtol"]
+    assert bk["int8"]["quarantined"] == []
+    assert bk["int8"]["grad_bucket_events"] >= 1
+    assert bk["fp32_layout_flip"]["bitexact"]
+    assert bk["fp32_layout_flip"]["continuity_ok"]
+    assert bk["fp32_layout_flip"]["quarantined"] == []
     assert (tmp_path / "report.json").exists()

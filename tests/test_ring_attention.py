@@ -2,7 +2,6 @@
 math, sharded sequence. Exercises the ppermute ring on the virtual mesh."""
 
 import dataclasses
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -15,38 +14,12 @@ from pyrecover_tpu.ops.attention import sdpa_attention
 from pyrecover_tpu.ops.ring_attention import ring_attention
 from pyrecover_tpu.parallel.mesh import MeshConfig, create_mesh
 
-
-@functools.lru_cache(maxsize=None)
-def _noncausal_ring_fwd_supported():
-    """Capability probe: legacy XLA (jax 0.4.x) cannot SPMD-partition the
-    NON-causal ring forward under plain jit — the lowering keeps a
-    PartitionId instruction the old partitioner rejects ("PartitionId
-    instruction is not supported for SPMD partitioning"). Probe the exact
-    failing shape class (non-causal fwd, sequence-sharded mesh, jit) on a
-    tiny problem instead of pinning a version: the skip self-heals the
-    moment the runtime can compile it. Returns (ok, reason)."""
-    q = k = v = jnp.ones((4, 8, 2, 4), jnp.float32)
-    mesh = create_mesh(MeshConfig(data=4, sequence=2))
-    sharding = NamedSharding(mesh, P("data", "sequence", None, None))
-    qs, ks, vs = (jax.device_put(x, sharding) for x in (q, k, v))
-    try:
-        with jax.sharding.set_mesh(mesh):
-            jax.block_until_ready(jax.jit(
-                lambda a, b_, c: ring_attention(a, b_, c, causal=False)
-            )(qs, ks, vs))
-        return True, ""
-    except Exception as e:  # the probe IS the capability question
-        return False, f"{type(e).__name__}: {str(e).splitlines()[0]}"
-
-
-def _require_noncausal_ring_fwd(causal):
-    if causal:
-        return
-    ok, reason = _noncausal_ring_fwd_supported()
-    if not ok:
-        pytest.skip(
-            f"non-causal ring forward not partitionable by this XLA: {reason}"
-        )
+# No capability skips: the non-causal ring used to be unpartitionable on
+# legacy XLA (jax 0.4.x rejected the PartitionId lowering of a DEAD
+# axis_index — positions only feed the causal mask), which made four of
+# these tests capability skips. ops/ring_attention.py now skips the
+# axis_index entirely when causal=False, so --sp is a supported
+# configuration on both XLA generations and every case below runs.
 
 
 def make_qkv(b=4, s=64, hq=4, hkv=2, d=32, seed=0):
@@ -61,7 +34,6 @@ def make_qkv(b=4, s=64, hq=4, hkv=2, d=32, seed=0):
 @pytest.mark.parametrize("causal", [True, False])
 @pytest.mark.parametrize("sp", [2, 4, 8])
 def test_ring_matches_sdpa(causal, sp, devices8):
-    _require_noncausal_ring_fwd(causal)
     q, k, v = make_qkv()
     ref = sdpa_attention(q, k, v, causal=causal)
 
@@ -112,7 +84,6 @@ def test_ring_nondivisible_block_kv_is_total(causal, devices8):
     ragged-edge pattern) with exact fwd AND grads. This replaced the
     full-score-matrix fallback that silently cost the memory bound the
     blockwise form exists for (round-4 verdict weak #7)."""
-    _require_noncausal_ring_fwd(causal)
     # per-device chunk = 96/2 = 48; block_kv = 20 → blocks 20/20/8
     q, k, v = make_qkv(s=96, seed=5)
     ref = sdpa_attention(q, k, v, causal=causal)

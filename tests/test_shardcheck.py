@@ -323,10 +323,10 @@ def test_sharded_precheck_uses_manifest(tmp_path):
 
 
 def test_check_catalog_complete():
-    """SC ids are exactly 1..12, unique, and every one is documented in
+    """SC ids are exactly 1..13, unique, and every one is documented in
     the README (id AND kebab-case name appear) — the PR 7 catalog drift
     (SC11 landing without its README row) can't recur silently."""
-    assert set(CHECKS) == {f"SC{i:02d}" for i in range(1, 13)}
+    assert set(CHECKS) == {f"SC{i:02d}" for i in range(1, 14)}
     names = [v[0] for v in CHECKS.values()]
     assert len(names) == len(set(names))
     readme = (REPO / "README.md").read_text(encoding="utf-8")

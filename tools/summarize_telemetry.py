@@ -237,6 +237,46 @@ def aggregate(events):
             e.get("description", "") for e in by.get("maintenance_event", [])
         ],
     }
+    # bandwidth-lean / overlap trail: what the step was BUILT to move
+    # (grad_quantize, PR 10), the effective bucket layout (grad_bucket)
+    # and the remat autoscaling decision (remat_autosize) — one record
+    # per run segment; the LAST one describes the current configuration
+    wire = {}
+    quant = by.get("grad_quantize", [])
+    if quant:
+        e = quant[-1]
+        wire["grad_quantize"] = {
+            "mode": e.get("mode"),
+            "optimizer_sharding": e.get("optimizer_sharding"),
+            "data_replicas": e.get("data_replicas"),
+            "wire_bytes_per_leg": e.get("wire_bytes_per_leg"),
+            "grad_bytes_fp32": e.get("grad_bytes_fp32"),
+        }
+    buckets = by.get("grad_bucket", [])
+    if buckets:
+        e = buckets[-1]
+        sizes = e.get("bucket_bytes_f32") or []
+        wire["grad_bucket"] = {
+            "bucket_mb": e.get("bucket_mb"),
+            "mode": e.get("mode"),
+            "buckets": e.get("buckets"),
+            "degenerate": e.get("degenerate"),
+            "min_bucket_bytes": e.get("min_bucket_bytes", min(sizes, default=0)),
+            "max_bucket_bytes": e.get("max_bucket_bytes", max(sizes, default=0)),
+            "events": len(buckets),
+        }
+    remat = by.get("remat_autosize", [])
+    if remat:
+        e = remat[-1]
+        wire["remat_autosize"] = {
+            "policy": e.get("policy"),
+            "fits": e.get("fits"),
+            "device_kind": e.get("device_kind"),
+            "budget_bytes": e.get("budget_bytes"),
+            "suggested_batch_per_chip": e.get("suggested_batch_per_chip"),
+        }
+    agg["wire"] = wire
+
     agg["warnings"] = [
         f"MFU denominator unknown for device kind {e.get('device_kind')!r}"
         for e in by.get("mfu_peak_unknown", [])
@@ -362,6 +402,39 @@ def render(agg, out=None):
         if agg["ckpt_fallbacks"]:
             w(f"  RESTORE FALLBACKS: {agg['ckpt_fallbacks']} "
               f"(corrupt/torn candidates skipped)\n")
+    wire = agg.get("wire") or {}
+    if wire:
+        w("\n-- bandwidth-lean / overlap configuration ----------------------\n")
+        gq = wire.get("grad_quantize")
+        if gq:
+            w(f"  gradient wire      {gq['mode']}/{gq['optimizer_sharding']} "
+              f"over {gq['data_replicas']} data replicas — "
+              f"{(gq.get('wire_bytes_per_leg') or 0) / 2**20:.1f} MiB/leg "
+              f"(fp32 grads {(gq.get('grad_bytes_fp32') or 0) / 2**20:.1f} "
+              f"MiB)\n")
+        gb = wire.get("grad_bucket")
+        if gb:
+            if gb.get("degenerate"):
+                w(f"  grad buckets       cap {gb['bucket_mb']:g} MiB "
+                  f"degenerate (one bucket) — unbucketed single "
+                  f"collective\n")
+            else:
+                w(f"  grad buckets       {gb['buckets']} @ cap "
+                  f"{gb['bucket_mb']:g} MiB ({gb['mode']}), "
+                  f"{(gb.get('min_bucket_bytes') or 0) / 2**20:.2f}.."
+                  f"{(gb.get('max_bucket_bytes') or 0) / 2**20:.2f} MiB "
+                  f"f32 each — per-bucket collectives overlap the "
+                  f"backward\n")
+        ra = wire.get("remat_autosize")
+        if ra:
+            budget = (
+                f"{(ra.get('budget_bytes') or 0) / 2**30:.1f} GiB"
+                if ra.get("budget_bytes") else "unknown"
+            )
+            w(f"  remat auto         policy {ra['policy']} on "
+              f"{ra.get('device_kind') or '<unknown>'} (budget {budget}, "
+              f"suggested per-chip batch "
+              f"{ra.get('suggested_batch_per_chip')})\n")
     ds = agg["data_stalls"]
     if ds["count"]:
         w(f"\n-- data loader: {ds['count']} stall(s), {ds['wait_s']}s waiting "
@@ -407,6 +480,7 @@ def main(argv=None):
                 "ckpt": agg["ckpt"],
                 "ckpt_backpressure": agg["ckpt_backpressure"],
                 "emergency": agg["emergency"],
+                "wire": agg["wire"],
                 "data_stalls": agg["data_stalls"],
                 "preempt": agg["preempt"],
             },
