@@ -199,4 +199,48 @@ print("doctor: OK — chaos exp healthy; hang drill classified as hang in "
       f"phase {hang['phase']} ({hang['evidence']['n_bundles']} bundle(s))")
 PYEOF
 
+# serving smoke: the continuous-batching engine's gate (pyrecover_tpu/
+# serving). Saves a tiny checkpoint on virtual devices, restores it
+# through the serving restore path (elastic preflight included), serves a
+# seeded Poisson workload under the load generator, and fails unless (a)
+# every request's greedy output is token-for-token equal to lockstep
+# generate_tokens, (b) every KV block is back on the free list at drain
+# (zero leaks — asserted inside the smoke), and (c) the latency report is
+# non-empty. The smoke's telemetry shard is then fed to
+# summarize_telemetry, which must render the request-latency percentiles.
+SERVING_WORK="${SERVING_WORK:-/tmp/pyrecover_serving_smoke}"
+rm -rf "$SERVING_WORK"
+if SRV_OUT=$(JAX_PLATFORMS=cpu python tools/bench_decode.py \
+    --smoke "$SERVING_WORK" 2>&1); then
+  SRV_LINE=$(echo "$SRV_OUT" | grep '"metric": "serving_smoke"' | tail -1) \
+    || SRV_LINE=""
+  SRV_LINE="$SRV_LINE" python - <<'PYEOF' || rc=1
+import json, os
+rep = json.loads(os.environ["SRV_LINE"])
+assert rep["ok"] and rep["metric"] == "serving_smoke", rep
+assert rep["greedy_matches"] == rep["requests"], \
+    "serving output diverged from lockstep decode"
+assert rep["tokens_per_sec"] and rep["ttft_s"]["p50"] is not None, \
+    f"empty latency report: {rep}"
+print(f"serving smoke: OK — {rep['requests']} requests greedy-equal to "
+      f"lockstep at {rep['tokens_per_sec']} tok/s, zero leaked KV blocks")
+PYEOF
+else
+  echo "$SRV_OUT"
+  rc=1
+fi
+if SRV_SUM=$(JAX_PLATFORMS=cpu python tools/summarize_telemetry.py \
+    "$SERVING_WORK/serving_telemetry.jsonl" 2>&1); then
+  if echo "$SRV_SUM" | grep -q "serving (request latency)" \
+      && echo "$SRV_SUM" | grep -q "ttft"; then
+    echo "$SRV_SUM" | grep -A 4 "serving (request latency)" | head -5
+  else
+    echo "summarize_telemetry: serving request-latency section missing"
+    rc=1
+  fi
+else
+  echo "$SRV_SUM"
+  rc=1
+fi
+
 exit $rc

@@ -203,7 +203,14 @@ def generate_tokens(params, config, prompt_ids, max_new_tokens, *,
     decodes in lockstep through one cache, so B prompts cost one model
     pass per token, not B. Ragged prompts are rejected loudly (left-pad
     them to a common length first — silent padding here would poison the
-    cache with attended pad positions)."""
+    cache with attended pad positions).
+
+    This is the LOCKSTEP compatibility path (and the equality baseline
+    the serving tests gate against): one batch admitted up front, every
+    sequence marching together, memory held until the slowest finishes.
+    Ragged prompts, mid-flight admissions, and paged KV memory live in
+    ``pyrecover_tpu.serving`` — same model math, token-for-token equal
+    at temperature=0 (test-pinned)."""
     cfg = config
     if not hasattr(prompt_ids, "__len__"):
         prompt_ids = list(prompt_ids)  # iterators/generators stay accepted
@@ -222,7 +229,25 @@ def generate_tokens(params, config, prompt_ids, max_new_tokens, *,
     if arr.shape[1] == 0:
         raise ValueError("prompt must contain at least one token id")
     n_batch, n_prompt = arr.shape
-    total = max_len or cfg.max_seq_len
+    if max_len is None:
+        total = cfg.max_seq_len
+    else:
+        # an explicit max_len is validated, never silently adjusted:
+        # max_len=0 used to fall through to cfg.max_seq_len, and an
+        # oversized value built a cache longer than the model's trained
+        # position range (RoPE extrapolates garbage past max_seq_len)
+        total = int(max_len)
+        if total <= 0:
+            raise ValueError(
+                f"max_len must be positive, got {max_len} (omit it to "
+                f"use the model's max_seq_len {cfg.max_seq_len})"
+            )
+        if total > cfg.max_seq_len:
+            raise ValueError(
+                f"max_len {max_len} exceeds the model's trained position "
+                f"range max_seq_len {cfg.max_seq_len} — positions past it "
+                "were never trained and would decode garbage"
+            )
     if n_prompt + max_new_tokens > total:
         raise ValueError(
             f"prompt ({n_prompt}) + max_new_tokens ({max_new_tokens}) "

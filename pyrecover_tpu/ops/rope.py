@@ -27,7 +27,10 @@ def precompute_rope(head_dim, max_seq_len, theta=500000.0, dtype=jnp.float32):
 
 
 def apply_rope(x, cos, sin):
-    """Rotate q or k. ``x``: (..., seq, heads, head_dim); cos/sin: (seq, head_dim//2).
+    """Rotate q or k. ``x``: (..., seq, heads, head_dim); cos/sin:
+    (seq, head_dim//2), or (..., seq, head_dim//2) with leading batch dims
+    when each batch row sits at its own absolute positions (the paged
+    decode path gathers a per-sequence position table).
 
     Interleaved-pair convention: elements (2i, 2i+1) form the complex pair,
     matching reference `model.py:101-127`. Computed in fp32, cast back.
@@ -36,9 +39,9 @@ def apply_rope(x, cos, sin):
     xf = x.astype(jnp.float32)
     x1 = xf[..., 0::2]
     x2 = xf[..., 1::2]
-    # broadcast cos/sin over batch and heads: (seq, 1, hd/2)
-    c = cos[:, None, :]
-    s = sin[:, None, :]
+    # broadcast cos/sin over (leading dims and) heads: (..., seq, 1, hd/2)
+    c = cos[..., :, None, :]
+    s = sin[..., :, None, :]
     r1 = x1 * c - x2 * s
     r2 = x2 * c + x1 * s
     out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)

@@ -1,0 +1,73 @@
+"""pyrecover_tpu.serving — continuous-batching inference engine.
+
+The "millions of users" path over the training stack's model math and
+checkpoints (ROADMAP item 1):
+
+  * :mod:`kvpool` — paged KV cache: fixed-size blocks in a preallocated
+    pool, host-side free list, per-sequence block tables; finished
+    sequences release memory mid-flight. int8 block-scaled KV storage
+    reuses the gradient collectives' symmetric quantizer for ~3.8× the
+    resident sequences per chip.
+  * :mod:`paged` — blockwise cached attention through the block table
+    at ragged per-sequence positions; two compiled programs (prefill
+    chunk + 1-token decode) serve every request mix without retracing.
+  * :mod:`engine` — the continuous-batching scheduler: admission
+    control tied to the free-block count (loud ``kv_backpressure``
+    instead of OOM), budgeted chunked prefill that never starves
+    decode, fixed-slot decode batching, per-request
+    queue/prefill/decode spans feeding ttft/tpot/e2e histograms.
+  * :mod:`restore` — read-only ``.params`` restore from any
+    vanilla/sharded/zerostall checkpoint, gated by the elastic
+    preflight and placed for the serving mesh.
+  * :mod:`loadgen` — seeded Poisson load generator, the lockstep
+    baseline, and the format.sh serving smoke gate.
+
+Event catalog additions (documented in ``telemetry/__init__`` and the
+README event table): ``request_admitted``, ``request_done``,
+``kv_backpressure``, ``weights_loaded``; spans ``req_queue`` /
+``req_prefill`` / ``req_decode`` / ``serving_restore``; histograms
+``ttft_s`` / ``tpot_s`` / ``e2e_s``.
+"""
+
+from pyrecover_tpu.serving.engine import (
+    Request,
+    ServingConfig,
+    ServingEngine,
+)
+from pyrecover_tpu.serving.kvpool import (
+    BlockPool,
+    blocks_for,
+    kv_block_bytes,
+    kv_token_bytes,
+    resident_sequences,
+)
+from pyrecover_tpu.serving.loadgen import (
+    lockstep_baseline,
+    run_loadgen,
+    sample_workload,
+    serving_smoke,
+)
+from pyrecover_tpu.serving.paged import paged_attention, paged_forward
+from pyrecover_tpu.serving.restore import (
+    ServingRestoreError,
+    load_serving_params,
+)
+
+__all__ = [
+    "BlockPool",
+    "Request",
+    "ServingConfig",
+    "ServingEngine",
+    "ServingRestoreError",
+    "blocks_for",
+    "kv_block_bytes",
+    "kv_token_bytes",
+    "load_serving_params",
+    "lockstep_baseline",
+    "paged_attention",
+    "paged_forward",
+    "resident_sequences",
+    "run_loadgen",
+    "sample_workload",
+    "serving_smoke",
+]

@@ -1,0 +1,464 @@
+"""Continuous-batching serving engine: prefill/decode split scheduler.
+
+``models/decode.py:generate_tokens`` is lockstep: one batch of
+equal-length prompts admitted up front, every sequence marching together
+through one contiguous cache, finished sequences holding their memory
+until the slowest one ends. This engine is the heavy-traffic path over
+the same model math:
+
+  * **Slots, not batches.** The decode step always runs at a fixed slot
+    width (``max_seqs``) with one token per live slot — the compiled
+    program never retraces as requests come and go. A finished sequence
+    releases its KV blocks and its slot mid-step; the next queued
+    request claims them at the next scheduler pass.
+  * **Prefill split from decode.** New requests prefill in their own
+    chunked jitted call (static ``prefill_chunk`` width, one sequence
+    at a time) under a per-pass token budget, so a long prompt can
+    never starve the running decode batch: at most
+    ``prefill_token_budget`` prompt tokens are processed between decode
+    steps. The sequence joins the decode batch at the step after its
+    prefill completes.
+  * **Admission control on free blocks.** A request is admitted only
+    when a decode slot is free AND the pool can cover its whole
+    lifetime (``ceil((prompt+max_new)/block_size)`` blocks) — mid-fligh
+    t allocation can therefore never fail, and pool pressure surfaces
+    as a loud ``kv_backpressure`` telemetry event (the
+    ``ckpt_backpressure`` precedent) instead of an OOM.
+  * **Request-level observability.** Every request carries monotonic
+    stamps through queue → prefill → decode; completion records
+    retroactive ``req_queue``/``req_prefill``/``req_decode`` spans and
+    feeds the ``ttft_s`` / ``tpot_s`` / ``e2e_s`` histograms (PR 5
+    metrics layer), plus ``request_admitted``/``request_done`` events.
+
+Threading contract (checked by ``concur --strict``): ``submit()`` may be
+called from any thread — the waiting queue is the ONLY state shared
+across threads and every touch holds ``_lock``. All scheduler state
+(slots, pool free list, in-flight requests) is mutated by exactly one
+consumer: either the caller pumping ``step()`` manually or the
+background thread started by ``start()`` — never both, enforced at
+runtime (``step()`` raises while the background loop owns the engine).
+Device work runs outside the lock.
+"""
+
+# concur: disable-file=unguarded-shared-state -- single-consumer protocol:
+# scheduler state (_slots, _tables, _prefill, _done, the pool free list)
+# is mutated only inside _pump(), which runs
+# on EITHER the caller's thread (manual step() pumping) or the background
+# serving thread — never both, enforced at runtime (step() raises while
+# the background loop owns the engine, start() refuses a second loop).
+# The only state genuinely shared across threads is the submission queue,
+# and every touch of it holds _lock.
+
+import dataclasses
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pyrecover_tpu import telemetry
+from pyrecover_tpu.serving.kvpool import (
+    KV_MODES,
+    BlockPool,
+    blocks_for,
+    make_block_table,
+)
+from pyrecover_tpu.serving.paged import paged_forward
+from pyrecover_tpu.telemetry import metrics
+
+# request lifecycle
+QUEUED, PREFILL, RUNNING, DONE = "queued", "prefill", "running", "done"
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    """Engine sizing knobs (all static — one compile per chunk width)."""
+
+    block_size: int = 16  # token positions per KV block
+    num_blocks: int = 0  # 0 -> derive from pool_bytes
+    pool_bytes: int = 0  # byte budget when num_blocks == 0
+    max_seqs: int = 4  # decode slot count (static batch width)
+    prefill_chunk: int = 32  # static prefill chunk width
+    prefill_token_budget: int = 64  # prefill tokens per scheduler pass
+    kv_mode: str = "native"  # "native" (pool in compute dtype) | "int8"
+    max_model_len: int = 0  # 0 -> model_config.max_seq_len
+
+    def __post_init__(self):
+        if self.kv_mode not in KV_MODES:
+            raise ValueError(
+                f"kv_mode must be one of {KV_MODES}, got {self.kv_mode!r}"
+            )
+        for name in ("block_size", "max_seqs", "prefill_chunk"):
+            if getattr(self, name) <= 0:
+                raise ValueError(
+                    f"{name} must be positive, got {getattr(self, name)}"
+                )
+        if self.prefill_token_budget < self.prefill_chunk:
+            raise ValueError(
+                f"prefill_token_budget ({self.prefill_token_budget}) must "
+                f"cover at least one prefill_chunk ({self.prefill_chunk}) "
+                "or prefill can never make progress"
+            )
+
+
+@dataclasses.dataclass
+class Request:
+    """One in-flight generation request (host-side bookkeeping only)."""
+
+    rid: int
+    prompt: list
+    max_new_tokens: int
+    eos_id: int = None
+    state: str = QUEUED
+    tokens: list = dataclasses.field(default_factory=list)  # prompt + new
+    blocks: list = None
+    slot: int = None
+    prefill_pos: int = 0  # prompt positions already cached
+    # monotonic stamps for the queue/prefill/decode spans
+    t_submit: float = 0.0
+    t_admit: float = None
+    t_first_token: float = None
+    t_done: float = None
+    backpressure_noted: bool = False
+
+    @property
+    def n_new(self):
+        return len(self.tokens) - len(self.prompt)
+
+    @property
+    def finished(self):
+        return self.state == DONE
+
+    def result(self):
+        """Prompt + generated ids (the ``generate_tokens`` return shape)."""
+        return list(self.tokens)
+
+
+class ServingEngine:
+    """Continuous-batching engine over the paged KV pool.
+
+    ``submit()`` is thread-safe; scheduling runs via ``step()`` (manual
+    pump) or ``start()``/``stop()`` (background thread). ``params`` is a
+    read-only weights pytree (``models/llama.py:init_params`` layout) —
+    typically restored by ``serving.restore.load_serving_params``.
+    """
+
+    def __init__(self, params, model_config,  # jaxlint: host-only
+                 serving_config=None):
+        self.params = params
+        self.model_config = model_config
+        self.config = serving_config or ServingConfig()
+        cfg = self.config
+        self.max_model_len = int(
+            cfg.max_model_len or model_config.max_seq_len
+        )
+        if self.max_model_len > model_config.max_seq_len:
+            raise ValueError(
+                f"max_model_len {self.max_model_len} exceeds the model's "
+                f"trained position range max_seq_len "
+                f"{model_config.max_seq_len}"
+            )
+        if cfg.num_blocks:
+            self.pool = BlockPool(
+                model_config, cfg.num_blocks, cfg.block_size,
+                kv_mode=cfg.kv_mode,
+            )
+        elif cfg.pool_bytes:
+            self.pool = BlockPool.from_budget(
+                model_config, cfg.pool_bytes, cfg.block_size,
+                kv_mode=cfg.kv_mode,
+            )
+        else:
+            # cover max_seqs full-length sequences plus the trash block
+            self.pool = BlockPool(
+                model_config,
+                cfg.max_seqs
+                * blocks_for(self.max_model_len, cfg.block_size) + 1,
+                cfg.block_size, kv_mode=cfg.kv_mode,
+            )
+        self.table_width = self.pool.table_width(self.max_model_len)
+
+        # the ONLY cross-thread state: submissions land here under _lock
+        self._lock = threading.Lock()
+        self._waiting = []  # FIFO of QUEUED requests
+        self._next_rid = 0
+
+        # single-consumer scheduler state (see the threading contract in
+        # the module docstring: exactly one pump thread mutates these)
+        self._prefill = []  # admitted, still caching their prompt
+        self._slots = [None] * cfg.max_seqs  # RUNNING requests
+        self._tables = np.tile(
+            make_block_table(self.table_width), (cfg.max_seqs, 1)
+        )
+        self._done = {}  # rid -> Request
+        self._arrays = self.pool.arrays
+
+        self._thread = None
+        self._stop = threading.Event()
+
+        def fwd(params, arrays, tokens, pos, tables):
+            return paged_forward(
+                params, arrays, tokens, pos, tables, model_config,
+                block_size=cfg.block_size, kv_mode=cfg.kv_mode,
+                rope_len=self.max_model_len,
+            )
+
+        # donate the pool: a decode step must not copy the whole pool
+        # through every scatter (the same donation decode.py applies)
+        self._prefill_fn = jax.jit(fwd, donate_argnums=1)
+        self._decode_fn = jax.jit(fwd, donate_argnums=1)
+
+    # ---- submission (any thread) -------------------------------------
+
+    def submit(self, prompt, max_new_tokens, *, eos_id=None):  # jaxlint: host-only
+        """Queue one request; returns its rid. Thread-safe."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("prompt must contain at least one token id")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        total = len(prompt) + int(max_new_tokens)
+        if total > self.max_model_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_model_len "
+                f"{self.max_model_len}"
+            )
+        req = Request(
+            rid=-1, prompt=prompt, max_new_tokens=int(max_new_tokens),
+            eos_id=eos_id, tokens=list(prompt),
+            t_submit=time.monotonic(),
+        )
+        with self._lock:
+            req.rid = self._next_rid
+            self._next_rid += 1
+            self._waiting.append(req)
+        return req.rid
+
+    def result(self, rid):  # jaxlint: host-only
+        """Finished request's token ids (prompt + generated), or None."""
+        req = self._done.get(rid)
+        return req.result() if req is not None else None
+
+    # ---- scheduling (single consumer) --------------------------------
+
+    @property
+    def pending(self):
+        with self._lock:
+            waiting = len(self._waiting)
+        return (
+            waiting + len(self._prefill)
+            + sum(1 for s in self._slots if s is not None)
+        )
+
+    def step(self):  # jaxlint: host-only
+        """One scheduler pass: admit → prefill (budgeted) → decode.
+        Returns True when any work was done. Must not race ``start()``'s
+        loop — manual pumping while the background thread runs raises."""
+        if self._thread is not None and threading.current_thread() is not self._thread:
+            raise RuntimeError(
+                "the background serving loop owns this engine; stop() it "
+                "before pumping step() manually"
+            )
+        return self._pump()
+
+    def run_until_drained(self, max_steps=100000):  # jaxlint: host-only
+        """Pump until every submitted request is DONE (test/bench mode)."""
+        for _ in range(max_steps):
+            if not self.step() and self.pending == 0:
+                return
+        raise RuntimeError(
+            f"engine did not drain in {max_steps} steps "
+            f"({self.pending} requests still pending)"
+        )
+
+    def start(self):  # jaxlint: host-only
+        """Serve from a background thread until ``stop()``."""
+        if self._thread is not None:
+            raise RuntimeError("serving loop already running")
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._serve_loop, name="serving-engine",
+        )
+        self._thread.start()
+
+    def stop(self, timeout=60.0):  # jaxlint: host-only
+        """Stop and JOIN the background loop (bounded — a wedged device
+        call surfaces as a TimeoutError naming the thread, the CC05
+        discipline)."""
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError(
+                "serving-engine thread did not stop within "
+                f"{timeout}s"
+            )
+        self._thread = None
+
+    def _serve_loop(self):
+        while not self._stop.is_set():
+            if not self._pump():
+                # idle: wait for submissions without spinning
+                self._stop.wait(0.001)
+
+    def _pump(self):
+        progressed = self._admit()
+        progressed = self._do_prefill() or progressed
+        progressed = self._do_decode() or progressed
+        return progressed
+
+    # admission: a request is admitted only when a slot AND its whole
+    # block footprint are available (no partial grants, no mid-flight
+    # allocation); the head-of-queue blocking loudly emits
+    # kv_backpressure exactly once per stall episode
+    def _admit(self):
+        admitted = False
+        while True:
+            free_slots = [
+                i for i, s in enumerate(self._slots) if s is None
+            ]
+            with self._lock:
+                if not self._waiting:
+                    return admitted
+                req = self._waiting[0]
+                need = blocks_for(
+                    len(req.prompt) + req.max_new_tokens,
+                    self.config.block_size,
+                )
+                blocked = not free_slots or need > self.pool.free_blocks
+                if blocked:
+                    note = not req.backpressure_noted
+                    req.backpressure_noted = True
+                else:
+                    self._waiting.pop(0)
+            if blocked:
+                if note:
+                    telemetry.emit(
+                        "kv_backpressure", rid=req.rid,
+                        needed_blocks=need,
+                        free_blocks=self.pool.free_blocks,
+                        free_slots=len(free_slots),
+                        queued=len(self._waiting),
+                    )
+                    metrics.counter("serving_backpressure_total").inc()
+                return admitted
+            req.blocks = self.pool.alloc(req.rid, need)
+            req.slot = free_slots[0]
+            req.state = PREFILL
+            req.t_admit = time.monotonic()
+            self._slots[req.slot] = req
+            self._tables[req.slot] = make_block_table(
+                self.table_width, req.blocks
+            )
+            self._prefill.append(req)
+            telemetry.emit(
+                "request_admitted", rid=req.rid,
+                prompt_tokens=len(req.prompt),
+                max_new_tokens=req.max_new_tokens, blocks=need,
+                slot=req.slot,
+                queue_s=round(req.t_admit - req.t_submit, 6),
+            )
+            admitted = True
+
+    # prefill: chunked, budgeted — at most prefill_token_budget prompt
+    # tokens per pass, so decode latency is bounded by a known constant
+    def _do_prefill(self):
+        cfg = self.config
+        budget = cfg.prefill_token_budget
+        progressed = False
+        while budget >= cfg.prefill_chunk and self._prefill:
+            req = self._prefill[0]
+            chunk, start = self._prefill_chunk_inputs(req)
+            logits, self._arrays = self._prefill_fn(
+                self.params, self._arrays, chunk,
+                jnp.asarray([start], jnp.int32),
+                jnp.asarray(self._tables[req.slot:req.slot + 1]),
+            )
+            budget -= cfg.prefill_chunk
+            progressed = True
+            req.prefill_pos = min(
+                start + cfg.prefill_chunk, len(req.prompt)
+            )
+            if req.prefill_pos >= len(req.prompt):
+                # final chunk: the last prompt position's logits yield
+                # the first generated token — TTFT stops here
+                last = len(req.prompt) - 1 - start
+                first = int(np.argmax(np.asarray(logits[0, last])))
+                self._prefill.pop(0)
+                req.t_first_token = time.monotonic()
+                req.tokens.append(first)
+                req.state = RUNNING
+                metrics.histogram("ttft_s").observe(
+                    req.t_first_token - req.t_submit
+                )
+                self._maybe_finish(req)
+        return progressed
+
+    def _prefill_chunk_inputs(self, req):
+        """Next prompt chunk, zero-padded to the static width (padding
+        positions are either overwritten before any query can attend
+        them or clamped into the trash block — see serving/paged.py)."""
+        cfg = self.config
+        start = req.prefill_pos
+        rows = req.prompt[start:start + cfg.prefill_chunk]
+        rows = rows + [0] * (cfg.prefill_chunk - len(rows))
+        return jnp.asarray([rows], jnp.int32), start
+
+    # decode: ONE fixed-width jitted step for every live slot; inactive
+    # slots run against the trash table and are ignored
+    def _do_decode(self):
+        live = [r for r in self._slots if r is not None and r.state == RUNNING]
+        if not live:
+            return False
+        tok = np.zeros((self.config.max_seqs, 1), np.int32)
+        pos = np.zeros((self.config.max_seqs,), np.int32)
+        for req in live:
+            tok[req.slot, 0] = req.tokens[-1]
+            pos[req.slot] = len(req.tokens) - 1
+        logits, self._arrays = self._decode_fn(
+            self.params, self._arrays, jnp.asarray(tok),
+            jnp.asarray(pos), jnp.asarray(self._tables),
+        )
+        logits = np.asarray(logits[:, 0])
+        for req in live:
+            req.tokens.append(int(np.argmax(logits[req.slot])))
+            self._maybe_finish(req)
+        return True
+
+    def _maybe_finish(self, req):
+        done = req.n_new >= req.max_new_tokens or (
+            req.eos_id is not None and req.tokens[-1] == req.eos_id
+        )
+        if not done:
+            return
+        req.t_done = time.monotonic()
+        req.state = DONE
+        self._slots[req.slot] = None
+        self._tables[req.slot] = make_block_table(self.table_width)
+        released = self.pool.release(req.rid)
+        self._done[req.rid] = req
+        ttft = req.t_first_token - req.t_submit
+        tpot = (req.t_done - req.t_first_token) / max(req.n_new - 1, 1)
+        e2e = req.t_done - req.t_submit
+        metrics.histogram("tpot_s").observe(tpot)
+        metrics.histogram("e2e_s").observe(e2e)
+        telemetry.record_span(
+            "req_queue", req.t_submit, req.t_admit, rid=req.rid,
+        )
+        telemetry.record_span(
+            "req_prefill", req.t_admit, req.t_first_token, rid=req.rid,
+        )
+        telemetry.record_span(
+            "req_decode", req.t_first_token, req.t_done, rid=req.rid,
+        )
+        telemetry.emit(
+            "request_done", rid=req.rid, prompt_tokens=len(req.prompt),
+            new_tokens=req.n_new, blocks_released=released,
+            ttft_s=round(ttft, 6), tpot_s=round(tpot, 6),
+            e2e_s=round(e2e, 6),
+        )

@@ -1,0 +1,207 @@
+"""Seeded load generator + serving benchmark/smoke harness.
+
+Drives the continuous-batching engine the way traffic would: Poisson
+arrivals (seeded, reproducible), mixed prompt/output lengths, client
+submissions from a separate thread while the engine's background loop
+schedules — then reports aggregate tokens/sec and tail latency
+(ttft/tpot/e2e p50/p95/p99 from the PR 5 metrics histograms) against
+the serial-lockstep baseline (``generate_tokens`` one request at a
+time, the pre-serving posture).
+
+``run_loadgen`` is the library entry (bench + tests);
+``serving_smoke`` is the CI gate body wired into ``format.sh``: it
+builds a tiny model, SAVES a real checkpoint, restores it through the
+serving restore path, serves a seeded workload, and asserts greedy
+equality vs lockstep, zero leaked KV blocks at drain, and a non-empty
+latency report.
+"""
+
+import time
+
+import numpy as np
+
+from pyrecover_tpu.serving.engine import ServingConfig, ServingEngine
+from pyrecover_tpu.telemetry import metrics
+
+
+def sample_workload(n_requests, *, vocab_size, max_model_len, seed=0,
+                    prompt_lens=(4, 48), new_tokens=(1, 24),
+                    arrival_rate=50.0):
+    """Seeded request mix: per-request prompts (uniform ragged lengths),
+    output budgets, and Poisson arrival offsets (exponential gaps at
+    ``arrival_rate`` req/s). Deterministic in ``seed``."""
+    rng = np.random.default_rng(seed)
+    reqs = []
+    t = 0.0
+    for _ in range(int(n_requests)):
+        p_len = int(rng.integers(prompt_lens[0], prompt_lens[1] + 1))
+        n_new = int(rng.integers(new_tokens[0], new_tokens[1] + 1))
+        total = p_len + n_new
+        if total > max_model_len:
+            p_len = max_model_len - n_new
+        t += float(rng.exponential(1.0 / arrival_rate))
+        reqs.append({
+            "prompt": rng.integers(0, vocab_size, (p_len,)).tolist(),
+            "max_new_tokens": n_new,
+            "arrival_s": t,
+        })
+    return reqs
+
+
+def _percentiles(hist):
+    return {
+        "p50": hist.percentile(0.50),
+        "p95": hist.percentile(0.95),
+        "p99": hist.percentile(0.99),
+    }
+
+
+def run_loadgen(engine, workload, *, timeout_s=600.0):  # jaxlint: host-only
+    """Submit ``workload`` at its arrival offsets from this (client)
+    thread while ``engine``'s background loop serves; block until every
+    request drains. Returns the latency/throughput report."""
+    t0 = time.monotonic()
+    rids = []
+    engine.start()
+    try:
+        for req in workload:
+            delay = req["arrival_s"] - (time.monotonic() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            rids.append(
+                engine.submit(req["prompt"], req["max_new_tokens"])
+            )
+        deadline = time.monotonic() + timeout_s
+        while engine.pending:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"loadgen: {engine.pending} requests still pending "
+                    f"after {timeout_s}s"
+                )
+            time.sleep(0.002)
+    finally:
+        engine.stop()
+    wall_s = time.monotonic() - t0
+    results = [engine.result(rid) for rid in rids]
+    new_tokens = sum(
+        req["max_new_tokens"] for req in workload
+    )
+    report = {
+        "requests": len(workload),
+        "wall_s": round(wall_s, 4),
+        "new_tokens": new_tokens,
+        "tokens_per_sec": round(new_tokens / max(wall_s, 1e-9), 2),
+        "ttft_s": _percentiles(metrics.histogram("ttft_s")),
+        "tpot_s": _percentiles(metrics.histogram("tpot_s")),
+        "e2e_s": _percentiles(metrics.histogram("e2e_s")),
+        "backpressure_events": metrics.counter(
+            "serving_backpressure_total"
+        ).value,
+    }
+    return results, report
+
+
+def lockstep_baseline(params, config, workload, *, max_len):  # jaxlint: host-only
+    """The serial pre-serving posture: one ``generate_tokens`` call per
+    request (ragged prompts cannot batch in lockstep), timed end to
+    end. Returns ``(results, report)`` in ``run_loadgen``'s shape."""
+    from pyrecover_tpu.models.decode import generate_tokens
+
+    t0 = time.monotonic()
+    results = [
+        generate_tokens(
+            params, config, req["prompt"], req["max_new_tokens"],
+            max_len=max_len,
+        )
+        for req in workload
+    ]
+    wall_s = time.monotonic() - t0
+    new_tokens = sum(req["max_new_tokens"] for req in workload)
+    return results, {
+        "requests": len(workload),
+        "wall_s": round(wall_s, 4),
+        "new_tokens": new_tokens,
+        "tokens_per_sec": round(new_tokens / max(wall_s, 1e-9), 2),
+    }
+
+
+def serving_smoke(workdir, *, n_requests=12, seed=0,  # jaxlint: host-only
+                  kv_mode="native"):
+    """The format.sh serving gate: save a tiny checkpoint, restore it
+    through the serving path, serve a seeded workload under the load
+    generator, and verify the three invariants — greedy equality vs
+    lockstep for EVERY request, zero leaked KV blocks at drain, and a
+    non-empty latency report. Returns the report dict (raises on any
+    violation)."""
+    from pathlib import Path
+
+    workdir = Path(workdir)
+    workdir.mkdir(parents=True, exist_ok=True)
+    # the smoke's own telemetry shard: the gate's summarize_telemetry
+    # pass renders the request-latency percentiles from this file
+    from pyrecover_tpu import telemetry
+
+    sink = telemetry.JsonlSink(workdir / "serving_telemetry.jsonl")
+    telemetry.add_sink(sink)
+    metrics.reset()
+    try:
+        return _serving_smoke_body(
+            workdir, n_requests=n_requests, seed=seed, kv_mode=kv_mode,
+        )
+    finally:
+        metrics.flush(reason="serving_smoke")
+        telemetry.remove_sink(sink)
+        sink.close()
+
+
+def _serving_smoke_body(workdir, *, n_requests, seed, kv_mode):
+    import jax
+
+    from pyrecover_tpu.checkpoint.vanilla import save_ckpt_vanilla
+    from pyrecover_tpu.config import TrainConfig
+    from pyrecover_tpu.models import ModelConfig, init_params
+    from pyrecover_tpu.optim import build_optimizer
+    from pyrecover_tpu.serving.restore import load_serving_params
+    from pyrecover_tpu.train_state import create_train_state
+
+    cfg = ModelConfig().tiny(
+        max_seq_len=96, vocab_size=64, compute_dtype="float32",
+        param_dtype="float32",
+    )
+    optimizer, _ = build_optimizer(TrainConfig())
+    state = create_train_state(jax.random.key(seed), cfg, optimizer)
+    ckpt = workdir / "ckpt_smoke.ckpt"
+    save_ckpt_vanilla(ckpt, state, {})
+    params, info = load_serving_params(ckpt, cfg)
+
+    engine = ServingEngine(params, cfg, ServingConfig(
+        block_size=8, max_seqs=4, prefill_chunk=16,
+        prefill_token_budget=32, kv_mode=kv_mode,
+    ))
+    workload = sample_workload(
+        n_requests, vocab_size=cfg.vocab_size,
+        max_model_len=engine.max_model_len, seed=seed,
+        prompt_lens=(3, 24), new_tokens=(1, 12), arrival_rate=200.0,
+    )
+    results, report = run_loadgen(engine, workload)
+    engine.pool.check_drained()  # zero leaked blocks, loudly
+
+    expected, _ = lockstep_baseline(
+        init_params(jax.random.key(seed), cfg), cfg, workload,
+        max_len=cfg.max_seq_len,
+    )
+    mismatched = [
+        i for i, (got, want) in enumerate(zip(results, expected))
+        if got != want
+    ]
+    if kv_mode == "native" and mismatched:
+        raise AssertionError(
+            f"paged serving diverged from lockstep decode on requests "
+            f"{mismatched} (of {len(results)})"
+        )
+    if not report["tokens_per_sec"] or report["ttft_s"]["p50"] is None:
+        raise AssertionError(f"empty latency report: {report}")
+    report["restore"] = info
+    report["greedy_matches"] = len(results) - len(mismatched)
+    report["kv_mode"] = kv_mode
+    return report

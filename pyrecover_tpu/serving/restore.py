@@ -1,0 +1,242 @@
+"""Read-only weight restore for serving: any checkpoint, any mesh.
+
+The serving engine is the first consumer of checkpoints outside the
+train loop. It needs exactly the ``.params`` subtree — no optimizer
+moments, no RNG, no step counters — restored read-only from whichever
+engine wrote the checkpoint (vanilla single file, Orbax sharded
+directory, zerostall chunk manifest) and placed for the SERVING mesh,
+which almost never matches the training topology.
+
+The path reuses the elastic machinery end to end: the saved manifest +
+topology are read without touching tensor data
+(``elastic.read_saved_meta``), the params-only reshard plan is computed
+and gated by ``elastic.preflight_elastic`` (SC11 infeasible grids, SC05
+target-HBM) BEFORE any tensor I/O, and the restore ``device_put``s each
+leaf onto its serving placement — replicated on the default device when
+no mesh is given, or sharded by the live partition rules on a serving
+mesh. Success emits one ``weights_loaded`` event carrying the plan's
+accounting; an infeasible plan raises :class:`ServingRestoreError`
+naming every finding instead of dying mid-restore.
+"""
+
+import re
+import time
+from pathlib import Path
+
+import numpy as np
+
+from pyrecover_tpu import telemetry
+from pyrecover_tpu.checkpoint.elastic import preflight_elastic, read_saved_meta
+from pyrecover_tpu.checkpoint.registry import engine_of
+
+PARAMS_PREFIX = ".params"
+_KEY_RE = re.compile(r"\['([^']*)'\]|\.([A-Za-z_][A-Za-z0-9_]*)|\[(\d+)\]")
+
+
+class ServingRestoreError(RuntimeError):
+    """The checkpoint cannot serve on this topology (preflight findings
+    or a params subtree the manifest does not carry)."""
+
+
+def _keystr_parts(path_str):
+    """``".params['layers']['wq']"`` -> ``["params", "layers", "wq"]``."""
+    parts = []
+    for m in _KEY_RE.finditer(path_str):
+        parts.append(m.group(1) if m.group(1) is not None
+                     else m.group(2) if m.group(2) is not None
+                     else int(m.group(3)))
+    return parts
+
+
+def _params_entries(manifest):
+    """Manifest leaves under ``.params``, with their subtree key paths."""
+    out = []
+    for entry in manifest.get("leaves", []):
+        if not entry["path"].startswith(PARAMS_PREFIX):
+            continue
+        parts = _keystr_parts(entry["path"])
+        if not parts or parts[0] != "params":
+            continue
+        out.append((parts[1:], entry))
+    if not out:
+        raise ServingRestoreError(
+            "checkpoint manifest carries no .params leaves — not a "
+            "training-state checkpoint this engine can serve from"
+        )
+    return out
+
+
+def _nest(flat):
+    """``[(key path, value)]`` -> nested dict tree (the params layout)."""
+    root = {}
+    for parts, value in flat:
+        node = root
+        for key in parts[:-1]:
+            node = node.setdefault(key, {})
+        node[parts[-1]] = value
+    return root
+
+
+def _read_params_vanilla(path):
+    from pyrecover_tpu.checkpoint.vanilla import read_ckpt_raw
+
+    _, paths, leaves = read_ckpt_raw(path)
+    flat = [
+        (_keystr_parts(p)[1:], np.asarray(leaf))
+        for p, leaf in zip(paths, leaves)
+        if p.startswith(PARAMS_PREFIX)
+    ]
+    return _nest(flat)
+
+
+def _read_params_zerostall(path):
+    from pyrecover_tpu.checkpoint.vanilla import _dtype_from_str
+    from pyrecover_tpu.checkpoint.zerostall.chunkstore import (
+        ChunkStore,
+        assemble_leaf,
+        read_manifest,
+    )
+
+    doc = read_manifest(path)
+    store = ChunkStore(Path(path).parent)
+    flat = []
+    for entry in doc["leaves"]:
+        p = entry["path"]
+        if not p.startswith(PARAMS_PREFIX):
+            continue
+        arr = assemble_leaf(store, entry, _dtype_from_str(entry["dtype"]))
+        flat.append((_keystr_parts(p)[1:], arr))
+    return _nest(flat)
+
+
+def _read_params_sharded(path):
+    """Raw (target-free) Orbax read of the ``state`` item; returns the
+    ``params`` subtree as host arrays."""
+    import orbax.checkpoint as ocp
+
+    with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
+        tree = ckptr.restore(Path(path) / "state")
+    params = tree["params"] if isinstance(tree, dict) else tree.params
+    import jax
+
+    flat = [
+        (_keystr_parts(jax.tree_util.keystr(p)), np.asarray(leaf))
+        for p, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
+    ]
+    return _nest(flat)
+
+
+_READERS = {
+    "vanilla": _read_params_vanilla,
+    "sharded": _read_params_sharded,
+    "zerostall": _read_params_zerostall,
+}
+
+
+def serving_topology(mesh=None):
+    """Topology record of the serving placement (the preflight target)."""
+    if mesh is not None:
+        from pyrecover_tpu.parallel.mesh import topology_of
+
+        return topology_of(mesh)
+    return {"devices": 1, "processes": 1, "mesh": {}}
+
+
+def serving_target_specs(manifest, mesh):
+    """Per-leaf target specs on the serving mesh: the live partition
+    rules filtered to the mesh's axes (``spec_for_manifest_path``), or
+    fully replicated when serving single-device."""
+    from pyrecover_tpu.analysis.shardcheck.manifest import spec_to_json
+    from pyrecover_tpu.parallel.mesh import _filter_spec_for_mesh
+    from pyrecover_tpu.parallel.sharding import spec_for_manifest_path
+
+    specs = {}
+    for entry in manifest.get("leaves", []):
+        if not entry["path"].startswith(PARAMS_PREFIX):
+            continue
+        if mesh is None:
+            specs[entry["path"]] = None
+            continue
+        spec = spec_for_manifest_path(entry["path"], len(entry["shape"]))
+        spec = _filter_spec_for_mesh(spec, tuple(mesh.axis_names))
+        specs[entry["path"]] = spec_to_json(spec)
+    return specs
+
+
+def load_serving_params(path, model_config, *, mesh=None,  # jaxlint: host-only
+                        device_kind=None):
+    """Restore the ``.params`` subtree of any checkpoint for serving.
+
+    Returns ``(params, info)`` — ``params`` placed for the serving mesh
+    (replicated single-device without one), ``info`` the reshard plan's
+    accounting plus the checkpoint step. Raises
+    :class:`ServingRestoreError` when the preflight gate rejects the
+    plan (indivisible leaf on the serving mesh, target HBM over budget).
+    """
+    path = Path(path)
+    t0 = time.monotonic()
+    engine = engine_of(path)
+    meta = read_saved_meta(path)
+    from pyrecover_tpu.analysis.shardcheck.manifest import (
+        manifest_from_ckpt_meta,
+    )
+
+    manifest = manifest_from_ckpt_meta(meta)
+    entries = _params_entries(manifest)
+    params_manifest = {
+        "schema": manifest.get("schema", 0),
+        "num_leaves": len(entries),
+        "leaves": [e for _, e in entries],
+    }
+    target_topology = serving_topology(mesh)
+    findings, plan = preflight_elastic(
+        params_manifest, meta.get("topology"), target_topology,
+        locus=f"serving:{path.name}", device_kind=device_kind,
+        target_specs=serving_target_specs(params_manifest, mesh),
+    )
+    if findings:
+        raise ServingRestoreError(
+            f"checkpoint {path.name} cannot serve on "
+            f"{target_topology}: "
+            + "; ".join(f"{f.rule_id}: {f.message}" for f in findings[:4])
+        )
+
+    with telemetry.span(
+        "serving_restore", engine=engine, path=str(path),
+        metric="serving_restore_s",
+    ):
+        host_params = _READERS[engine](path)
+        placed = _place_params(host_params, mesh)
+    info = {
+        "engine": engine, "step": int(meta.get("step", 0)),
+        "leaves": len(entries),
+        "bytes": int(plan.total_bytes),
+        "resharded_leaves": int(plan.resharded_leaves),
+        "plan_bytes_moved": int(plan.bytes_moved),
+        "seconds": round(time.monotonic() - t0, 4),
+    }
+    telemetry.emit(
+        "weights_loaded", path=str(path),
+        target_topology=target_topology, **info,
+    )
+    return placed, info
+
+
+def _place_params(host_params, mesh):
+    """``device_put`` the host tree onto its serving placement — the
+    partition rules under a mesh, the default device otherwise."""
+    import jax
+
+    if mesh is None:
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(jnp_readonly(x)), host_params
+        )
+    from pyrecover_tpu.parallel.sharding import shard_params
+
+    return shard_params(host_params, mesh)
+
+
+def jnp_readonly(x):
+    """Host leaf -> a fresh array safe to place (decouples the result
+    from any mmap'd checkpoint read buffer)."""
+    return np.ascontiguousarray(x)

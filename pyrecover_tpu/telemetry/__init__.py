@@ -83,6 +83,26 @@ Core event names across the stack (fields beyond the envelope):
                       --remat-policy auto: the policy utils/remat.py
                       sized against the SC05 HBM model, with the
                       per-chip batch the freed headroom could carry)
+    request_admitted  rid, prompt_tokens, max_new_tokens, blocks, slot,
+                      queue_s (the serving scheduler admitted a request:
+                      a decode slot plus its WHOLE KV-block footprint
+                      were reserved — mid-flight allocation can never
+                      fail after this)
+    request_done      rid, prompt_tokens, new_tokens, blocks_released,
+                      ttft_s, tpot_s, e2e_s (a request finished; its KV
+                      blocks went back to the free list mid-flight and
+                      its latencies fed the ttft_s/tpot_s/e2e_s
+                      histograms — the serving SLO surface)
+    kv_backpressure   rid, needed_blocks, free_blocks, free_slots,
+                      queued (the KV pool or slot table cannot admit the
+                      head-of-queue request; it waits loudly — the
+                      ckpt_backpressure precedent — instead of OOMing;
+                      emitted once per stall episode)
+    weights_loaded    engine, path, step, leaves, bytes,
+                      resharded_leaves, plan_bytes_moved, seconds,
+                      target_topology (the serving engine restored the
+                      .params subtree read-only from a checkpoint,
+                      preflighted and placed for the serving mesh)
     preempt_check     step, time_left_s, threshold_s
     preempt_notice / preempt_stop / preempt_estimate
     preempt_signal_escalation  signal, count, step (2nd signal mid-save)
@@ -106,6 +126,12 @@ Core event names across the stack (fields beyond the envelope):
     spec_axis_dropped axis, mesh_axes (a sharding spec named a missing axis)
     ckpt_manifest_dtype_drift  path, detail (resume will cast the leaf)
     run_summary       status, step, + WallTimeTotals.as_dict() (goodput)
+
+Serving spans + histograms (``serving/engine.py``; README "Serving"):
+retroactive ``req_queue`` / ``req_prefill`` / ``req_decode`` spans per
+finished request, a ``serving_restore`` span around the weight restore,
+and the ``ttft_s`` / ``tpot_s`` / ``e2e_s`` request-latency histograms
+(p50/p95/p99 rendered by ``tools/summarize_telemetry.py``).
 
 Tracing + metrics events (``spans.py`` / ``metrics.py``; see README
 "Tracing & trace analysis" for the span catalog):

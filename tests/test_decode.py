@@ -120,6 +120,28 @@ def test_generate_rejects_overflow():
         generate_tokens(params, CFG, [1] * 30, 10)
 
 
+def test_generate_validates_max_len():
+    """Regression (serving PR satellite): an explicit max_len used to be
+    trusted silently — max_len=0 fell back to cfg.max_seq_len via the
+    `or`, and max_len > cfg.max_seq_len built a cache past the model's
+    trained position range (RoPE extrapolation garbage)."""
+    params, _ = make_inputs()
+    import pytest
+
+    with pytest.raises(ValueError, match="max_len must be positive"):
+        generate_tokens(params, CFG, [1, 2], 4, max_len=0)
+    with pytest.raises(ValueError, match="max_len must be positive"):
+        generate_tokens(params, CFG, [1, 2], 4, max_len=-8)
+    with pytest.raises(ValueError, match="trained position range"):
+        generate_tokens(params, CFG, [1, 2], 4,
+                        max_len=CFG.max_seq_len + 1)
+    # the valid forms keep working: omitted (model default) and an
+    # explicit in-range cap — and both agree token-for-token
+    want = generate_tokens(params, CFG, [1, 2], 4)
+    got = generate_tokens(params, CFG, [1, 2], 4, max_len=CFG.max_seq_len)
+    assert want == got
+
+
 def test_blockwise_cache_crosses_block_boundaries():
     """A cache longer than one decode block must reproduce the training
     forward across positions spanning several blocks — the online-softmax

@@ -1,18 +1,23 @@
-"""KV-cached decode throughput at the flagship preset.
+"""Decode/serving throughput bench (BENCH JSON contract).
 
-The reference has no generation path (SURVEY §2: training-only); this
-measures OUR serving-path claim — that a decode step costs O(cache fill),
-not O(max_len), and that batched prompts decode in lockstep through one
-cache (models/decode.py). The headline value is steady-state decode
-throughput with the prefill cost CANCELLED: two timed generations (1 new
-token vs N new tokens) share an identical prefill, so their time
-difference is N-1 pure decode steps.
+Three modes, all printing exactly ONE JSON line on stdout:
 
-Prints ONE JSON line:
-  {"metric": "decode_tok_per_sec", "value": N, "unit": "tok/s",
-   "extra": {"per_seq_tok_s": ..., "ms_per_step": ..., "platform": ...}}
+  * default — the lockstep steady-state decode number (unchanged
+    contract: two timed generations with identical prefill, their
+    difference is pure decode steps).
+  * ``--serving`` — the continuous-batching engine under the seeded
+    Poisson load generator (``pyrecover_tpu/serving/loadgen.py``):
+    mixed prompt/output lengths on concurrent streams vs the
+    serial-lockstep baseline, with ttft/tpot/e2e p50/p95/p99 and the
+    fp32-vs-int8 resident-sequence capacity ledger in
+    ``extra.serving`` — the serving numbers land in the same
+    trajectory files as training MFU.
+  * ``--smoke DIR`` — the format.sh serving gate: tiny checkpoint →
+    serving restore → load generator on virtual devices, asserting
+    greedy equality vs lockstep, zero leaked KV blocks at drain, and a
+    non-empty latency report. Exit 1 on any violation.
 
-Run (tunnel up): python tools/bench_decode.py [--batch 8] [--new 128] ...
+Run (tunnel up): python tools/bench_decode.py [--serving] [--batch 8] ...
 """
 
 import argparse
@@ -27,38 +32,12 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 from bench import _guard_against_dead_accelerator  # noqa: E402
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--model", default="llama-1b")
-    ap.add_argument("--batch", type=int, default=8)
-    ap.add_argument("--prompt-len", type=int, default=128)
-    ap.add_argument("--new", type=int, default=128)
-    ap.add_argument("--max-len", type=int, default=512)
-    args = ap.parse_args()
-
-    _guard_against_dead_accelerator()
-
-    import jax
+def _lockstep_bench(args, cfg, params, platform):
+    """The original steady-state lockstep number (prefill cancelled)."""
     import numpy as np
 
-    from pyrecover_tpu.models import presets
     from pyrecover_tpu.models.decode import generate_tokens
-    from pyrecover_tpu.models.llama import init_params
 
-    platform = jax.devices()[0].platform
-    if platform == "cpu" and args.model == "llama-1b":
-        # CPU fallback (dead tunnel): shrink like bench.py does so an
-        # honest platform=cpu line still prints inside the campaign's row
-        # timeout instead of grinding a 1B decode on one core. The
-        # recorder retries cpu rows, so this line is evidence, not data.
-        args.model, args.batch, args.new = "llama-150m", 2, 16
-        args.prompt_len, args.max_len = 16, 64
-
-    cfg = dataclasses.replace(
-        presets.PRESETS[args.model](max_seq_len=args.max_len),
-        param_dtype="bfloat16", compute_dtype="bfloat16", remat=False,
-    )
-    params = init_params(jax.random.key(0), cfg)
     rng = np.random.default_rng(0)
     prompts = rng.integers(
         0, cfg.vocab_size, (args.batch, args.prompt_len)
@@ -81,7 +60,7 @@ def main():
     )
     decode_s = max(t_full - t_one, 1e-9)
     steps = args.new - 1
-    print(json.dumps({
+    return {
         "metric": "decode_tok_per_sec",
         "value": round(args.batch * steps / decode_s, 1),
         "unit": "tok/s",
@@ -96,7 +75,155 @@ def main():
             "e2e_s_incl_prefill": round(t_full, 3),
             "platform": platform,
         },
-    }))
+    }
+
+
+def _serving_bench(args, cfg, params, platform):
+    """Continuous batching vs the serial-lockstep baseline on the SAME
+    seeded workload; extra.serving is the BENCH trajectory record."""
+    from pyrecover_tpu.serving.engine import ServingConfig, ServingEngine
+    from pyrecover_tpu.serving.kvpool import resident_sequences
+    from pyrecover_tpu.serving.loadgen import (
+        lockstep_baseline,
+        run_loadgen,
+        sample_workload,
+    )
+    from pyrecover_tpu.telemetry import metrics
+
+    max_model_len = args.max_len
+    workload = sample_workload(
+        args.requests, vocab_size=cfg.vocab_size,
+        max_model_len=max_model_len, seed=args.seed,
+        prompt_lens=(args.prompt_len // 4, args.prompt_len),
+        new_tokens=(args.new // 4, args.new),
+        arrival_rate=args.arrival_rate,
+    )
+    _, base = lockstep_baseline(params, cfg, workload, max_len=max_model_len)
+
+    scfg = ServingConfig(
+        block_size=args.block_size, max_seqs=args.max_seqs,
+        prefill_chunk=args.prefill_chunk,
+        prefill_token_budget=2 * args.prefill_chunk,
+        kv_mode=args.kv_mode, max_model_len=max_model_len,
+    )
+    engine = ServingEngine(params, cfg, scfg)
+    # warm both compiles outside the timed window (arrival offsets start
+    # the clock at t0; a 30 s first-compile would poison every ttft)
+    warm = engine.submit([1] * min(4, max_model_len - 1), 1)
+    engine.run_until_drained()
+    assert engine.result(warm) is not None
+    metrics.reset()
+    results, rep = run_loadgen(engine, workload)
+    engine.pool.check_drained()
+    assert all(r is not None for r in results)
+
+    pool_bytes = engine.pool.pool_bytes()
+    capacity = {
+        mode: resident_sequences(
+            pool_bytes, cfg, args.block_size, mode, max_model_len,
+            dtype="float32" if mode == "native" else None,
+        )
+        for mode in ("native", "int8")
+    }
+    pct = lambda d: {k: (round(v, 6) if v is not None else None)  # noqa: E731
+                     for k, v in d.items()}
+    serving = {
+        "requests": rep["requests"],
+        "tokens_per_sec": rep["tokens_per_sec"],
+        "baseline_tokens_per_sec": base["tokens_per_sec"],
+        "speedup_vs_lockstep": round(
+            rep["tokens_per_sec"] / max(base["tokens_per_sec"], 1e-9), 2
+        ),
+        "ttft_s": pct(rep["ttft_s"]),
+        "tpot_s": pct(rep["tpot_s"]),
+        "e2e_s": pct(rep["e2e_s"]),
+        "backpressure_events": rep["backpressure_events"],
+        "kv_mode": args.kv_mode,
+        "block_size": args.block_size,
+        "max_seqs": args.max_seqs,
+        "pool_bytes": pool_bytes,
+        "capacity_fp32": capacity["native"],
+        "capacity_int8": capacity["int8"],
+        "capacity_ratio": round(
+            capacity["int8"] / max(capacity["native"], 1), 2
+        ),
+    }
+    print(
+        f"serving: {rep['tokens_per_sec']} tok/s vs lockstep "
+        f"{base['tokens_per_sec']} ({serving['speedup_vs_lockstep']}x), "
+        f"ttft p50 {serving['ttft_s']['p50']}s, int8 capacity "
+        f"{capacity['int8']} vs fp32 {capacity['native']} seqs",
+        file=sys.stderr,
+    )
+    return {
+        "metric": "serving_tok_per_sec",
+        "value": rep["tokens_per_sec"],
+        "unit": "tok/s",
+        "extra": {
+            "model": args.model,
+            "platform": platform,
+            "serving": serving,
+        },
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="llama-1b")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=128)
+    ap.add_argument("--new", type=int, default=128)
+    ap.add_argument("--max-len", type=int, default=512)
+    ap.add_argument("--serving", action="store_true",
+                    help="continuous-batching loadgen bench")
+    ap.add_argument("--smoke", metavar="DIR", default=None,
+                    help="format.sh serving gate (tiny model, asserts)")
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--arrival-rate", type=float, default=100.0)
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--max-seqs", type=int, default=8)
+    ap.add_argument("--prefill-chunk", type=int, default=32)
+    ap.add_argument("--kv-mode", default="native",
+                    choices=("native", "int8"))
+    args = ap.parse_args()
+
+    if args.smoke is not None:
+        from pyrecover_tpu.serving.loadgen import serving_smoke
+
+        report = serving_smoke(args.smoke, seed=args.seed)
+        print(json.dumps({"metric": "serving_smoke", "ok": True,
+                          **report}, default=str))
+        return
+
+    _guard_against_dead_accelerator()
+
+    import jax
+
+    from pyrecover_tpu.models import presets
+    from pyrecover_tpu.models.llama import init_params
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu" and args.model == "llama-1b":
+        # CPU fallback (dead tunnel): shrink like bench.py does so an
+        # honest platform=cpu line still prints inside the campaign's row
+        # timeout instead of grinding a 1B decode on one core. The
+        # recorder retries cpu rows, so this line is evidence, not data.
+        args.model, args.batch, args.new = "llama-150m", 2, 16
+        args.prompt_len, args.max_len = 16, 64
+        args.requests, args.max_seqs = 8, 4
+        args.prefill_chunk, args.block_size = 8, 8
+
+    cfg = dataclasses.replace(
+        presets.PRESETS[args.model](max_seq_len=args.max_len),
+        param_dtype="bfloat16", compute_dtype="bfloat16", remat=False,
+    )
+    params = init_params(jax.random.key(0), cfg)
+
+    if args.serving:
+        print(json.dumps(_serving_bench(args, cfg, params, platform)))
+    else:
+        print(json.dumps(_lockstep_bench(args, cfg, params, platform)))
 
 
 if __name__ == "__main__":

@@ -277,6 +277,45 @@ def aggregate(events):
         }
     agg["wire"] = wire
 
+    # serving rollup: request-latency percentiles straight from the
+    # request_done trail (ttft/tpot/e2e per finished request), plus the
+    # admission/backpressure/weights-loaded digests — the serving
+    # engine's observability contract (README "Serving")
+    done = by.get("request_done", [])
+    serving = {}
+    if done or by.get("request_admitted") or by.get("kv_backpressure") \
+            or by.get("weights_loaded"):
+        def _req_pct(field):
+            samples = [
+                (float(e[field]), 1)
+                for e in done if isinstance(e.get(field), (int, float))
+            ]
+            return {
+                label: (
+                    round(_wpercentile(samples, q), 6)
+                    if samples else None
+                )
+                for label, q in (("p50", 0.50), ("p95", 0.95),
+                                 ("p99", 0.99))
+            }
+
+        serving = {
+            "requests_admitted": len(by.get("request_admitted", [])),
+            "requests_done": len(done),
+            "new_tokens": sum(int(e.get("new_tokens", 0)) for e in done),
+            "ttft_s": _req_pct("ttft_s"),
+            "tpot_s": _req_pct("tpot_s"),
+            "e2e_s": _req_pct("e2e_s"),
+            "kv_backpressure": len(by.get("kv_backpressure", [])),
+            "weights_loaded": [
+                {"engine": e.get("engine"), "step": e.get("step"),
+                 "leaves": e.get("leaves"),
+                 "resharded_leaves": e.get("resharded_leaves")}
+                for e in by.get("weights_loaded", [])
+            ],
+        }
+    agg["serving"] = serving
+
     agg["warnings"] = [
         f"MFU denominator unknown for device kind {e.get('device_kind')!r}"
         for e in by.get("mfu_peak_unknown", [])
@@ -435,6 +474,27 @@ def render(agg, out=None):
               f"{ra.get('device_kind') or '<unknown>'} (budget {budget}, "
               f"suggested per-chip batch "
               f"{ra.get('suggested_batch_per_chip')})\n")
+    sv = agg.get("serving") or {}
+    if sv:
+        w("\n-- serving (request latency) -----------------------------------\n")
+        w(f"  requests           {sv['requests_done']} done of "
+          f"{sv['requests_admitted']} admitted "
+          f"({sv['new_tokens']} tokens generated)\n")
+        for name, label in (("ttft_s", "ttft"), ("tpot_s", "tpot"),
+                            ("e2e_s", "e2e")):
+            p = sv.get(name) or {}
+            if p.get("p50") is None:
+                continue
+            w(f"  {label:<18} p50 {p['p50'] * 1e3:9.2f}ms  "
+              f"p95 {p['p95'] * 1e3:9.2f}ms  "
+              f"p99 {p['p99'] * 1e3:9.2f}ms\n")
+        if sv.get("kv_backpressure"):
+            w(f"  KV BACKPRESSURE    {sv['kv_backpressure']} admission "
+              f"stall(s) — pool exhausted, requests queued loudly\n")
+        for wl in sv.get("weights_loaded", []):
+            w(f"  weights loaded     {wl.get('engine')} checkpoint @ step "
+              f"{wl.get('step')} ({wl.get('leaves')} leaves, "
+              f"{wl.get('resharded_leaves')} resharded)\n")
     ds = agg["data_stalls"]
     if ds["count"]:
         w(f"\n-- data loader: {ds['count']} stall(s), {ds['wait_s']}s waiting "
@@ -481,6 +541,7 @@ def main(argv=None):
                 "ckpt_backpressure": agg["ckpt_backpressure"],
                 "emergency": agg["emergency"],
                 "wire": agg["wire"],
+                "serving": agg["serving"],
                 "data_stalls": agg["data_stalls"],
                 "preempt": agg["preempt"],
             },
