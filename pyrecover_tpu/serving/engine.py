@@ -227,6 +227,18 @@ class ServingEngine:
                 f"({max_new_tokens}) exceeds max_model_len "
                 f"{self.max_model_len}"
             )
+        # a footprint beyond the pool's TOTAL usable blocks can never be
+        # admitted — without this check it would park at the head of the
+        # FIFO forever (one kv_backpressure event, then silence),
+        # deadlocking every request queued behind it
+        need = blocks_for(total, self.config.block_size)
+        if need > self.pool.usable_blocks:
+            raise ValueError(
+                f"request needs {need} KV blocks ({total} positions at "
+                f"block_size {self.config.block_size}) but the pool only "
+                f"has {self.pool.usable_blocks} usable blocks; grow "
+                f"num_blocks/pool_bytes or shrink the request"
+            )
         req = Request(
             rid=-1, prompt=prompt, max_new_tokens=int(max_new_tokens),
             eos_id=eos_id, tokens=list(prompt),
@@ -258,7 +270,8 @@ class ServingEngine:
         """One scheduler pass: admit → prefill (budgeted) → decode.
         Returns True when any work was done. Must not race ``start()``'s
         loop — manual pumping while the background thread runs raises."""
-        if self._thread is not None and threading.current_thread() is not self._thread:
+        owner = self._loop_owner()
+        if owner is not None and threading.current_thread() is not owner:
             raise RuntimeError(
                 "the background serving loop owns this engine; stop() it "
                 "before pumping step() manually"
@@ -275,9 +288,21 @@ class ServingEngine:
             f"({self.pending} requests still pending)"
         )
 
+    def _loop_owner(self):
+        """The background thread while it actually runs. A loop that
+        wedged past ``stop()``'s join timeout but later exited on its
+        own no longer owns the engine — treating the dead thread as an
+        owner would leave the engine permanently unusable (step() and
+        start() refusing forever with no loop running)."""
+        t = self._thread
+        if t is not None and t.ident is not None and not t.is_alive():
+            self._thread = None
+            return None
+        return t
+
     def start(self):  # jaxlint: host-only
         """Serve from a background thread until ``stop()``."""
-        if self._thread is not None:
+        if self._loop_owner() is not None:
             raise RuntimeError("serving loop already running")
         self._stop.clear()
         self._thread = threading.Thread(
@@ -288,7 +313,9 @@ class ServingEngine:
     def stop(self, timeout=60.0):  # jaxlint: host-only
         """Stop and JOIN the background loop (bounded — a wedged device
         call surfaces as a TimeoutError naming the thread, the CC05
-        discipline)."""
+        discipline). After a timed-out join the still-running thread
+        keeps ownership, but the stop flag stays set: once the thread
+        unwedges and exits, step()/start() recover automatically."""
         if self._thread is None:
             return
         self._stop.set()
@@ -417,12 +444,21 @@ class ServingEngine:
             return False
         tok = np.zeros((self.config.max_seqs, 1), np.int32)
         pos = np.zeros((self.config.max_seqs,), np.int32)
+        # non-RUNNING slots (idle, or a partially-prefilled request whose
+        # slot already carries a real block table) must decode against a
+        # trash-only row: paged_forward writes KV for EVERY batch row, so
+        # handing it the real table would overwrite the sequence's
+        # position-0 KV with the dummy tok=0/pos=0 entry on every pass
+        tables = np.tile(
+            make_block_table(self.table_width), (self.config.max_seqs, 1)
+        )
         for req in live:
             tok[req.slot, 0] = req.tokens[-1]
             pos[req.slot] = len(req.tokens) - 1
+            tables[req.slot] = self._tables[req.slot]
         logits, self._arrays = self._decode_fn(
             self.params, self._arrays, jnp.asarray(tok),
-            jnp.asarray(pos), jnp.asarray(self._tables),
+            jnp.asarray(pos), jnp.asarray(tables),
         )
         logits = np.asarray(logits[:, 0])
         for req in live:
