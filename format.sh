@@ -55,6 +55,19 @@ python tools/jaxlint.py pyrecover_tpu tools bench.py __graft_entry__.py \
 python tools/concur.py pyrecover_tpu tools bench.py __graft_entry__.py \
   --strict --json "${CONCUR_JSON:-/tmp/concur_report.json}" || rc=1
 
+# distcheck: static multi-host collective-congruence analysis
+# (pyrecover_tpu/analysis/distcheck — pure stdlib, same engine/suppression
+# machinery under the `distcheck:` namespace). Machine-checks the SPMD
+# protocol discipline the resilience stack documents in prose: no
+# collective gated on a single host's state (DC01), congruent collective
+# sequences across branch arms (DC02), host-0 verdicts broadcast before
+# they steer control flow (DC03), no collectives in reach of swallowed
+# exceptions (DC04), every raw multihost wait bounded by a
+# collective_phase (DC05), collective trip counts never driven by
+# host-local state (DC06). JSON report beside the others (DISTCHECK_JSON).
+python tools/distcheck.py pyrecover_tpu tools bench.py __graft_entry__.py \
+  --strict --json "${DISTCHECK_JSON:-/tmp/distcheck_report.json}" || rc=1
+
 # shardcheck: abstract SPMD preflight (pyrecover_tpu/analysis/shardcheck).
 # Every shipped preset must validate clean — partition-spec divisibility,
 # axis use, replication, collective census — on 1/2/4/8-device virtual

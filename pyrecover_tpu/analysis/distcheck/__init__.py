@@ -1,0 +1,63 @@
+"""distcheck — static multi-host collective-congruence analysis.
+
+The fourth axis of the analysis space: jaxlint checks JAX *syntax*
+hazards, shardcheck checks SPMD *launch semantics*, concur checks
+*threading semantics* — and distcheck checks SPMD **control-flow
+congruence**: the property that every host of a pod reaches the same
+collectives, in the same order, the same number of times. Its failure
+mode is the one no other gate can catch and no single-process test can
+reproduce: one host enters a collective the others never reach, and the
+job hangs forever with no exception, no crash, no artifact — the
+deadlock class that makes reconfigurable multi-host training dangerous
+(Fault Tolerant Reconfigurable ML Multiprocessor, arxiv 2511.08381) and
+that distributed checkpointing stacks enforce by convention only.
+
+The analyzer reuses the shared engine end to end: the same
+:class:`~pyrecover_tpu.analysis.engine.ModuleInfo` parsing, the same
+cross-module call graph, the same suppression syntax under the
+``distcheck:`` comment namespace (tool-scoped: a jaxlint or concur
+disable can never silence a DC finding, nor the reverse), and the same
+text/JSON reporters. ``model.py`` extracts the host-divergence model —
+divergence *sources* (``process_index()`` comparisons, per-host env
+reads, filesystem probes, host-local exception state, functions whose
+returns are host-local) and *collective sites* (psum / all_gather /
+process_allgather / sync_global_devices / the broadcast helpers / the
+emergency peer exchange), propagated through the call graph so a
+collective buried three calls under a rank-gated branch is still
+attributed.
+
+The rule catalog (``rules.py``): DC01 rank-gated-collective, DC02
+divergent-collective-order, DC03 unbroadcast-verdict, DC04
+collective-under-swallowed-exception, DC05
+unbounded-distributed-blocking, DC06 local-state-collective-count.
+
+Function markers steer the model (parsed cross-tool like jaxlint's)::
+
+    def peek(exp_dir):   # distcheck: host-local   <- returns per-host state
+    def config_hash():   # distcheck: congruent    <- provably same everywhere
+
+Suppressions carry jaxlint's exact shape under the ``distcheck:``
+namespace, and the test suite rejects justification-free ones::
+
+    if not self._notice_present():  # distcheck: disable=rank-gated-collective -- why
+
+CLI: ``tools/distcheck.py`` (console script ``distcheck``), gated in
+``format.sh`` with ``--strict`` over the whole repo.
+"""
+
+from pyrecover_tpu.analysis.distcheck.model import DistConfig, DistModel
+from pyrecover_tpu.analysis.distcheck.rules import (
+    DC_RULES,
+    analyze_modules,
+    analyze_paths,
+    analyze_source,
+)
+
+__all__ = [
+    "DC_RULES",
+    "DistConfig",
+    "DistModel",
+    "analyze_modules",
+    "analyze_paths",
+    "analyze_source",
+]

@@ -1,0 +1,607 @@
+"""Host-divergence model: what can differ across hosts, and which code
+paths carry collectives.
+
+Everything the DC rule catalog consumes is computed here from the same
+:class:`~pyrecover_tpu.analysis.engine.ModuleInfo` parse jaxlint and
+concur use:
+
+* **Divergence sources** — expressions whose value can differ across the
+  hosts of one SPMD job: ``jax.process_index()`` (and names bound to a
+  rank comparison), per-host environment reads (``os.environ.get`` /
+  ``os.getenv`` / ``os.environ[...]``), filesystem *existence* probes
+  (``.exists()`` / ``.glob()`` / ``os.listdir`` / …), host RNG
+  (``random.*``), exception state (a ``try`` whose handler continues is
+  host-divergent control flow by nature), and calls to functions whose
+  RETURN value is host-local — derived as a fixpoint over return
+  statements, seeded/overridden by the ``# distcheck: host-local`` and
+  ``# distcheck: congruent`` function markers. Deliberately NOT sources:
+  ``process_count()`` (identical on every host), global-array properties
+  (``.is_fully_addressable``), wall clocks, and file *content* reads —
+  content divergence is the checkpoint prechecks' domain, and treating
+  every ``read_text`` as divergent would drown the signal.
+* **Laundering** — a value that passed through a broadcast helper
+  (``broadcast_host0_scalar`` / ``broadcast_host0_obj`` /
+  ``broadcast_one_to_all``) is congruent by construction: the expression
+  walker never descends into a broadcast call's subtree, and a
+  reassignment from a laundered expression clears the name's taint.
+* **Collective sites** — direct calls (by name: psum / all_gather /
+  process_allgather / sync_global_devices / the broadcast helpers / …)
+  plus a transitive closure over the cross-module call graph, so a
+  collective buried three calls under a rank-gated branch is still
+  attributed to that branch. Jitted functions are NOT excluded: a
+  multi-host GSPMD program with collectives dispatched from only one
+  host deadlocks exactly like a host-side collective.
+* **Raw primitives & bounds** — direct ``multihost_utils.*`` calls are
+  the unboundable waits; a call is *bounded* when an enclosing ``with``
+  is a ``collective_phase(...)`` region (DC05's contract).
+
+Per-function analysis (:meth:`DistModel.fn_report`) runs one linear,
+control-flow-ordered walk maintaining a taint table:
+
+* names assigned from divergent expressions carry ``(reason, kind)``
+  taint — kind ``rank`` for rank comparisons, ``local`` for everything
+  else;
+* names assigned *inside* a rank-gated branch carry kind ``verdict``
+  (the host-0-computed decision, whatever its RHS);
+* reassignment from a congruent/laundered expression clears taint.
+
+The walk records the observations the rules consume: host-divergent
+``if`` statements with each arm's ordered collective sequence and
+termination shape, control-flow uses of unbroadcast verdicts, loops
+whose trip count is host-local with collectives in the body, and ``try``
+statements whose handlers swallow in collective-bearing protocols.
+"""
+
+import ast
+import dataclasses
+
+from pyrecover_tpu.analysis.callgraph import ProjectIndex, dotted_name
+from pyrecover_tpu.analysis.engine import DEFAULT_CONFIG
+
+# collective operations, matched on the call's last name component — the
+# concur catalog plus the structured host-0 broadcast helper
+COLLECTIVE_NAMES = {
+    "process_allgather", "broadcast_one_to_all", "sync_global_devices",
+    "broadcast_host0_scalar", "broadcast_host0_obj", "psum", "pmean",
+    "pmax", "pmin", "all_gather", "all_to_all", "ppermute", "pbroadcast",
+}
+
+# passing through one of these makes a host-divergent value congruent
+# (host 0's copy lands everywhere); the expression walker skips their
+# argument subtrees entirely
+BROADCAST_HELPERS = {
+    "broadcast_host0_scalar", "broadcast_host0_obj", "broadcast_one_to_all",
+}
+
+# raw multihost primitives: the unboundable cross-host waits DC05 demands
+# a `collective_phase` region around
+RAW_PRIMITIVES = {
+    "sync_global_devices", "broadcast_one_to_all", "process_allgather",
+}
+_RAW_MODULE = "jax.experimental.multihost_utils"
+
+# filesystem EXISTENCE probes (content reads deliberately excluded)
+FS_PROBE_ATTRS = {
+    "exists", "is_file", "is_dir", "glob", "rglob", "iterdir", "stat",
+}
+FS_PROBE_DOTTED = {
+    "os.path.exists", "os.path.isfile", "os.path.isdir", "os.listdir",
+    "os.scandir", "os.stat", "os.walk",
+}
+
+_TERMINATOR_CALLS = {"os._exit", "sys.exit", "exit", "quit", "os.abort"}
+
+
+@dataclasses.dataclass
+class DistConfig:
+    """Rule selection + project knowledge for the congruence analysis."""
+
+    select: frozenset = None
+    ignore: frozenset = frozenset()
+    # the jaxlint LintConfig supplying the fuzzy-method blacklist for
+    # call resolution (concur's `result` extension kept: Future.result()
+    # must never alias a project method)
+    lint: object = dataclasses.field(
+        default_factory=lambda: dataclasses.replace(
+            DEFAULT_CONFIG,
+            fuzzy_method_blacklist=(
+                DEFAULT_CONFIG.fuzzy_method_blacklist | {"result"}
+            ),
+        )
+    )
+
+    def rule_enabled(self, name, rule_id):
+        if name in self.ignore or rule_id in self.ignore:
+            return False
+        if self.select is None:
+            return True
+        return name in self.select or rule_id in self.select
+
+
+DEFAULT_DIST_CONFIG = DistConfig()
+
+
+def _last_component(call):
+    d = dotted_name(call.func)
+    if d is not None:
+        return d.rsplit(".", 1)[-1]
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return None
+
+
+@dataclasses.dataclass
+class FnFacts:
+    """Per-function raw facts (one pass, shared by closures and reports)."""
+
+    collectives: list = dataclasses.field(default_factory=list)  # (node, desc)
+    raw_prims: list = dataclasses.field(default_factory=list)  # (node, desc, bounded)
+    calls: list = dataclasses.field(default_factory=list)  # (node, target)
+
+
+@dataclasses.dataclass
+class DivIf:
+    """One host-divergent ``if``: the DC01/DC02 unit of analysis."""
+
+    node: object
+    reason: str
+    kind: str  # "rank" | "verdict" | "local"
+    body_colls: list  # ordered collective descs reachable from the body arm
+    else_colls: list  # same for the else arm (empty list when no else)
+    body_term: bool  # the body arm terminates control flow
+    else_term: bool
+    after_colls: list  # collective descs lexically after the if in this fn
+
+
+@dataclasses.dataclass
+class FnReport:
+    """Everything one function contributes to the DC rules."""
+
+    div_ifs: list = dataclasses.field(default_factory=list)
+    verdict_uses: list = dataclasses.field(default_factory=list)  # (node, name, reason)
+    div_loops: list = dataclasses.field(default_factory=list)  # (node, reason, colls)
+    swallow_trys: list = dataclasses.field(default_factory=list)  # (handler, colls)
+
+
+_KIND_RANKING = {"rank": 3, "verdict": 2, "local": 1}
+
+
+class DistModel:
+    """Project-wide host-divergence facts; built once, consumed by rules."""
+
+    def __init__(self, modules, config=None):
+        self.config = config or DEFAULT_DIST_CONFIG
+        self.index = ProjectIndex(modules)
+        self.modules = list(modules)
+        self.by_path = {m.relpath: m for m in self.modules}
+        self.facts = {}
+        for fn in self.index.functions:
+            self.facts[fn] = self._function_facts(fn)
+        self._coll_closure = {}
+        self.divergent_returns = self._compute_divergent_returns()
+        self.reports = {
+            fn: self._walk_fn(fn) for fn in self.index.functions
+        }
+
+    # ---- call/fact extraction ----------------------------------------------
+
+    def _resolve_call(self, module, call):
+        """jaxlint's resolver + the ``from pkg import mod; mod.fn()`` edge
+        (the same extension concur carries)."""
+        target = self.index.resolve_call(module, call, self.config.lint)
+        if target is not None:
+            return target
+        func = call.func
+        if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+            imp = self.index.from_imports.get(module, {}).get(func.value.id)
+            if imp is not None:
+                mod_dotted = f"{imp[0]}.{imp[1]}" if imp[0] else imp[1]
+                return self.index._project_function(mod_dotted, func.attr)
+        return None
+
+    def _is_raw_primitive(self, module, call):
+        last = _last_component(call)
+        if last not in RAW_PRIMITIVES:
+            return False
+        d = dotted_name(call.func)
+        if d is not None and (
+            d.startswith("multihost_utils.") or d.startswith(_RAW_MODULE)
+        ):
+            return True
+        if isinstance(call.func, ast.Name):
+            imp = self.index.from_imports.get(module, {}).get(call.func.id)
+            if imp is not None and imp[0] == _RAW_MODULE:
+                return True
+        return False
+
+    def _is_bounded(self, module, node):
+        """Is ``node`` inside a ``with collective_phase(...)`` region?"""
+        for anc in module.ancestors(node):
+            if isinstance(anc, ast.With):
+                for item in anc.items:
+                    expr = item.context_expr
+                    if isinstance(expr, ast.Call) and _last_component(
+                        expr
+                    ) == "collective_phase":
+                        return True
+        return False
+
+    def _function_facts(self, fn):
+        module = fn.module
+        facts = FnFacts()
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            if module.enclosing_function(node) is not fn.node:
+                continue
+            target = self._resolve_call(module, node)
+            facts.calls.append((node, target))
+            last = _last_component(node)
+            if self._is_raw_primitive(module, node):
+                facts.raw_prims.append((
+                    node, f"{dotted_name(node.func) or last}()",
+                    self._is_bounded(module, node),
+                ))
+            if last in COLLECTIVE_NAMES:
+                facts.collectives.append((node, f"{last}()"))
+        return facts
+
+    def collective_closure(self, fn):
+        """((desc, via_qualname), ...) collectives ``fn`` eventually
+        issues, deduped by description (closest site kept)."""
+        if fn in self._coll_closure:
+            return self._coll_closure[fn]
+        self._coll_closure[fn] = ()  # cycle guard
+        out = [(d, fn.qualname) for _, d in self.facts[fn].collectives]
+        seen_children = set()
+        for _, target in self.facts[fn].calls:
+            if target is not None and target not in seen_children:
+                seen_children.add(target)
+                out.extend(self.collective_closure(target))
+        deduped, seen = [], set()
+        for item in out:
+            if item[0] not in seen:
+                seen.add(item[0])
+                deduped.append(item)
+        self._coll_closure[fn] = tuple(deduped)
+        return self._coll_closure[fn]
+
+    # ---- divergence of expressions -----------------------------------------
+
+    def _marked(self, fn, marker):
+        return fn is not None and marker in fn.markers
+
+    def expr_divergence(self, module, expr, taint):
+        """``(reason, kind)`` when ``expr``'s value can differ across
+        hosts, else None. Broadcast-helper subtrees and calls to
+        ``# distcheck: congruent``-marked functions are skipped
+        (laundered)."""
+        found = []
+
+        def visit(node):
+            if isinstance(node, ast.Call):
+                last = _last_component(node)
+                if last in BROADCAST_HELPERS:
+                    return  # laundered: never descend
+                target = self._resolve_call(module, node)
+                if self._marked(target, "congruent"):
+                    return
+                d = dotted_name(node.func)
+                if last == "process_index":
+                    found.append(("jax.process_index()", "rank"))
+                elif d in ("os.environ.get", "os.getenv"):
+                    found.append((f"{d}() per-host env read", "local"))
+                elif d in FS_PROBE_DOTTED:
+                    found.append((f"{d}() filesystem probe", "local"))
+                elif isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in FS_PROBE_ATTRS:
+                    found.append(
+                        (f".{node.func.attr}() filesystem probe", "local")
+                    )
+                elif d is not None and d.startswith("random."):
+                    found.append((f"{d}() host RNG", "local"))
+                elif self._marked(target, "host-local") or (
+                    target is not None and target in self.divergent_returns
+                ):
+                    found.append((
+                        f"{target.qualname}() returns host-local state",
+                        "local",
+                    ))
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+                return
+            if isinstance(node, ast.Subscript):
+                if dotted_name(node.value) == "os.environ":
+                    found.append(("os.environ[...] per-host env read",
+                                  "local"))
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                entry = taint.get(node.id)
+                if entry is not None:
+                    # propagate the ROOT reason unchanged (no nesting of
+                    # quoted names through assignment chains)
+                    found.append(entry)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        visit(expr)
+        if not found:
+            return None
+        found.sort(key=lambda f: -_KIND_RANKING[f[1]])
+        return found[0]
+
+    def _compute_divergent_returns(self):
+        """Fixpoint: functions whose return value is host-local. Markers
+        win in both directions (``host-local`` forces membership,
+        ``congruent`` forces exclusion)."""
+        self.divergent_returns = set(
+            fn for fn in self.index.functions
+            if self._marked(fn, "host-local")
+        )
+        congruent = {
+            fn for fn in self.index.functions
+            if self._marked(fn, "congruent")
+        }
+        for _ in range(8):  # cross-module chains are short; cap the walk
+            changed = False
+            for fn in self.index.functions:
+                if fn in self.divergent_returns or fn in congruent:
+                    continue
+                if self._fn_returns_divergent(fn):
+                    self.divergent_returns.add(fn)
+                    changed = True
+            if not changed:
+                break
+        return self.divergent_returns
+
+    def _fn_returns_divergent(self, fn):
+        """Run the linear walk with a probe on Return statements."""
+        hit = []
+
+        def on_return(node, taint):
+            if node.value is None or hit:
+                return
+            if self.expr_divergence(fn.module, node.value, taint):
+                hit.append(node)
+
+        self._walk_fn(fn, on_return=on_return)
+        return bool(hit)
+
+    # ---- the per-function walk ---------------------------------------------
+
+    def _arm_collectives(self, module, stmts):
+        """Ordered collective descriptions reachable from a statement
+        list: direct calls plus transitive attribution through resolved
+        callees (nested defs excluded — they run when called, not here)."""
+        out = []
+        for stmt in stmts:
+            owner = module.enclosing_function(stmt)
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                # calls inside a nested def run when IT is called — they
+                # belong to that function's own report, not this arm's
+                if module.enclosing_function(node) is not owner:
+                    continue
+                last = _last_component(node)
+                if last in COLLECTIVE_NAMES:
+                    out.append((node.lineno, node.col_offset, f"{last}()"))
+                    continue
+                target = self._resolve_call(module, node)
+                if target is not None:
+                    closure = self.collective_closure(target)
+                    if closure:
+                        desc, via = closure[0]
+                        out.append((
+                            node.lineno, node.col_offset,
+                            f"{desc} via {via}()",
+                        ))
+        out.sort()
+        return [d for _, _, d in out]
+
+    @staticmethod
+    def _arm_terminates(stmts):
+        """SILENT termination only (Return/Continue/Break): the process
+        lives on but skips everything after the branch — the divergence
+        that hangs peers. ``raise`` and ``os._exit`` are the LOUD exits:
+        the process dies, the distributed runtime notices, and the
+        bounded collective_phase turns the peers' wait into a named
+        timeout — failing loudly is the sanctioned way to diverge."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.Return, ast.Continue, ast.Break)):
+                return True
+        return False
+
+    def _colls_after_line(self, fn, line):
+        """Collective descs in ``fn`` anchored after ``line`` (lexical
+        approximation of "later on this control path")."""
+        module = fn.module
+        out = []
+        for node, desc in self.facts[fn].collectives:
+            if node.lineno > line:
+                out.append(desc)
+        for node, target in self.facts[fn].calls:
+            if node.lineno > line and target is not None:
+                closure = self.collective_closure(target)
+                if closure:
+                    desc, via = closure[0]
+                    out.append(f"{desc} via {via}()")
+        return out
+
+    def _handler_swallows(self, handler):
+        """A handler that neither re-raises (anywhere — a conditional
+        pod-only ``raise`` counts) nor terminates the process continues
+        locally: host-divergent control flow past the exception."""
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Raise):
+                return False
+            if isinstance(node, ast.Call) and \
+                    dotted_name(node.func) in _TERMINATOR_CALLS:
+                return False
+        return True
+
+    def fn_report(self, fn):
+        return self.reports[fn]
+
+    def _walk_fn(self, fn, on_return=None):
+        """One linear, control-flow-ordered walk of ``fn``'s statements
+        maintaining the taint table; returns the FnReport."""
+        module = fn.module
+        taint = {}  # name -> (reason, kind)
+        report = FnReport()
+
+        def assign_names(target, entry):
+            if isinstance(target, ast.Name):
+                if entry is None:
+                    taint.pop(target.id, None)
+                else:
+                    taint[target.id] = entry
+            elif isinstance(target, (ast.Tuple, ast.List)):
+                for elt in target.elts:
+                    assign_names(elt, entry)
+            elif isinstance(target, ast.Starred):
+                assign_names(target.value, entry)
+
+        def handle_assign(stmt, under_rank_gate):
+            value = getattr(stmt, "value", None)
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+                targets = [stmt.target]
+            div = (
+                self.expr_divergence(module, value, taint)
+                if value is not None else None
+            )
+            if under_rank_gate:
+                # whatever the RHS, the ASSIGNMENT only happened on the
+                # gated hosts: the name now holds a host-0 verdict
+                entry = (
+                    f"assigned under the host-gated branch at line "
+                    f"{stmt.lineno}", "verdict",
+                )
+                if div is not None and _KIND_RANKING[div[1]] > \
+                        _KIND_RANKING["verdict"]:
+                    entry = div
+            else:
+                entry = div
+            for t in targets:
+                assign_names(t, entry)
+
+        def walk(stmts, under_rank_gate):
+            for stmt in stmts:
+                if isinstance(stmt, (
+                    ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                )):
+                    continue  # nested defs analyzed as their own functions
+                if isinstance(stmt, (
+                    ast.Assign, ast.AnnAssign, ast.AugAssign,
+                )):
+                    handle_assign(stmt, under_rank_gate)
+                elif isinstance(stmt, ast.Return):
+                    if on_return is not None:
+                        on_return(stmt, dict(taint))
+                elif isinstance(stmt, ast.If):
+                    div = self.expr_divergence(module, stmt.test, taint)
+                    # inside a rank-gated region everything is host-0-
+                    # local by construction: a divergent inner branch
+                    # cannot desynchronize hosts that never run it, and
+                    # any collective in here already belongs to the
+                    # OUTER rank-gated if's arm analysis
+                    if div is not None and not under_rank_gate:
+                        reason, kind = div
+                        body_colls = self._arm_collectives(
+                            module, stmt.body
+                        )
+                        else_colls = self._arm_collectives(
+                            module, stmt.orelse
+                        )
+                        report.div_ifs.append(DivIf(
+                            node=stmt, reason=reason, kind=kind,
+                            body_colls=body_colls, else_colls=else_colls,
+                            body_term=self._arm_terminates(stmt.body),
+                            else_term=self._arm_terminates(stmt.orelse),
+                            after_colls=self._colls_after_line(
+                                fn, stmt.end_lineno or stmt.lineno
+                            ),
+                        ))
+                        if kind == "verdict":
+                            # the unbroadcast-verdict use (DC03): name the
+                            # tainted name driving the test
+                            name = next((
+                                n.id for n in ast.walk(stmt.test)
+                                if isinstance(n, ast.Name)
+                                and taint.get(n.id, ("", ""))[1] == "verdict"
+                            ), None)
+                            if name is not None:
+                                report.verdict_uses.append(
+                                    (stmt, name, reason)
+                                )
+                    gated = under_rank_gate or (
+                        div is not None and div[1] == "rank"
+                    )
+                    walk(stmt.body, gated)
+                    walk(stmt.orelse, gated)
+                elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    div = self.expr_divergence(module, stmt.iter, taint)
+                    if div is not None:
+                        if not under_rank_gate:
+                            colls = self._arm_collectives(
+                                module, stmt.body
+                            )
+                            if colls:
+                                report.div_loops.append(
+                                    (stmt, div[0], colls)
+                                )
+                        assign_names(stmt.target, div)
+                    else:
+                        assign_names(stmt.target, None)
+                    walk(stmt.body, under_rank_gate)
+                    walk(stmt.orelse, under_rank_gate)
+                elif isinstance(stmt, ast.While):
+                    div = self.expr_divergence(module, stmt.test, taint)
+                    if div is not None and not under_rank_gate:
+                        colls = self._arm_collectives(module, stmt.body)
+                        if colls:
+                            report.div_loops.append(
+                                (stmt, div[0], colls)
+                            )
+                        if div[1] == "verdict":
+                            name = next((
+                                n.id for n in ast.walk(stmt.test)
+                                if isinstance(n, ast.Name)
+                                and taint.get(n.id, ("", ""))[1] == "verdict"
+                            ), None)
+                            if name is not None:
+                                report.verdict_uses.append(
+                                    (stmt, name, div[0])
+                                )
+                    walk(stmt.body, under_rank_gate)
+                    walk(stmt.orelse, under_rank_gate)
+                elif isinstance(stmt, ast.Try):
+                    # a swallowed exception inside a rank-gated region is
+                    # host-0-local: the continuation rejoins the verdict
+                    # broadcast like every other gated path
+                    if not under_rank_gate:
+                        try_colls = self._arm_collectives(
+                            module, stmt.body
+                        )
+                        after_colls = self._colls_after_line(
+                            fn, stmt.end_lineno or stmt.lineno
+                        )
+                        for handler in stmt.handlers:
+                            if self._handler_swallows(handler) and (
+                                try_colls or after_colls
+                            ):
+                                report.swallow_trys.append(
+                                    (handler, try_colls or after_colls)
+                                )
+                    walk(stmt.body, under_rank_gate)
+                    for handler in stmt.handlers:
+                        walk(handler.body, under_rank_gate)
+                    walk(stmt.orelse, under_rank_gate)
+                    walk(stmt.finalbody, under_rank_gate)
+                elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    walk(stmt.body, under_rank_gate)
+
+        walk(list(fn.node.body), False)
+        return report

@@ -1,0 +1,270 @@
+"""The distcheck rule catalog: DC01–DC06 over the host-divergence model.
+
+Rules are project-level (they consume the cross-module
+:class:`~pyrecover_tpu.analysis.distcheck.model.DistModel`), like
+concur's — a collective buried three calls under a rank-gated branch is
+only attributable with every module on the table. Each rule returns
+:class:`~pyrecover_tpu.analysis.engine.Finding` objects; suppression
+resolution (the ``# distcheck: disable=...`` namespace) happens in
+:func:`analyze_modules` through the same engine machinery jaxlint and
+concur use — a jaxlint/concur directive can never silence a DC finding,
+nor the reverse.
+"""
+
+import dataclasses
+
+from pyrecover_tpu.analysis.distcheck.model import (
+    DEFAULT_DIST_CONFIG,
+    DistModel,
+)
+from pyrecover_tpu.analysis.engine import Finding, ModuleInfo, _load_modules
+
+DC_RULES = {}
+
+
+@dataclasses.dataclass
+class Rule:
+    id: str
+    name: str
+    severity: str
+    summary: str
+    check: object
+
+
+def rule(rule_id, name, severity, summary):
+    def deco(fn):
+        DC_RULES[name] = Rule(rule_id, name, severity, summary, fn)
+        return fn
+
+    return deco
+
+
+def finding(r, module, node, message):
+    return Finding(
+        rule=r.name, rule_id=r.id, severity=r.severity, path=module.relpath,
+        line=getattr(node, "lineno", 1),
+        col=getattr(node, "col_offset", 0) + 1, message=message,
+    )
+
+
+# ---- DC01: collective reachable on only one arm of a divergent branch -------
+
+
+@rule(
+    "DC01", "rank-gated-collective", "error",
+    "a collective is reachable on only one arm of a host-divergent "
+    "branch — the hosts that take the other arm never enter it, and the "
+    "participants wait forever (the canonical SPMD deadlock)",
+)
+def check_rank_gated(model, config):
+    out = []
+    for fn in sorted(model.reports, key=lambda f: f.qualname):
+        for div in model.reports[fn].div_ifs:
+            if bool(div.body_colls) != bool(div.else_colls):
+                colls = div.body_colls or div.else_colls
+                arm = "true" if div.body_colls else "else"
+                out.append(finding(
+                    DC_RULES["rank-gated-collective"], fn.module, div.node,
+                    f"{colls[0]} is reachable only on the {arm} arm of a "
+                    f"branch on {div.reason} in {fn.qualname}; hosts that "
+                    "take the other arm never enter the collective — "
+                    "hoist it out of the branch or broadcast the decision "
+                    "first",
+                ))
+            elif (
+                not div.body_colls and not div.else_colls
+                and div.body_term != div.else_term
+                and div.after_colls
+            ):
+                out.append(finding(
+                    DC_RULES["rank-gated-collective"], fn.module, div.node,
+                    f"a branch on {div.reason} in {fn.qualname} exits "
+                    f"early on one arm while {div.after_colls[0]} waits "
+                    "later in the function — only the hosts that fall "
+                    "through reach the collective; coordinate the early "
+                    "exit (broadcast the decision) first",
+                ))
+    return out
+
+
+# ---- DC02: both arms reach collectives, but different ones -------------------
+
+
+@rule(
+    "DC02", "divergent-collective-order", "error",
+    "the arms of a host-divergent branch reach DIFFERENT collective "
+    "sequences — hosts pair up mismatched collectives (or mismatched "
+    "counts) and exchange garbage or deadlock mid-protocol",
+)
+def check_divergent_order(model, config):
+    out = []
+    for fn in sorted(model.reports, key=lambda f: f.qualname):
+        for div in model.reports[fn].div_ifs:
+            if (
+                div.body_colls and div.else_colls
+                and div.body_colls != div.else_colls
+            ):
+                out.append(finding(
+                    DC_RULES["divergent-collective-order"], fn.module,
+                    div.node,
+                    f"branch on {div.reason} in {fn.qualname} reaches "
+                    f"[{', '.join(div.body_colls)}] on the true arm but "
+                    f"[{', '.join(div.else_colls)}] on the else arm; "
+                    "every host must issue the same collective sequence "
+                    "— make the arms congruent or broadcast the decision",
+                ))
+    return out
+
+
+# ---- DC03: host-0 verdict feeding control flow without a broadcast ----------
+
+
+@rule(
+    "DC03", "unbroadcast-verdict", "error",
+    "a value computed under a host-gated branch steers all-host control "
+    "flow without passing through a broadcast helper — the `_resume` "
+    "verdict discipline (host 0 decides, broadcast, THEN branch), "
+    "machine-checked",
+)
+def check_unbroadcast_verdict(model, config):
+    out = []
+    for fn in sorted(model.reports, key=lambda f: f.qualname):
+        for node, name, reason in model.reports[fn].verdict_uses:
+            out.append(finding(
+                DC_RULES["unbroadcast-verdict"], fn.module, node,
+                f"'{name}' was {reason} and steers control flow in "
+                f"{fn.qualname} without a broadcast: hosts other than "
+                "the deciding one hold a stale/default value — route it "
+                "through broadcast_host0_scalar/broadcast_host0_obj "
+                "first",
+            ))
+    return out
+
+
+# ---- DC04: collective in reach of a swallowed exception ----------------------
+
+
+@rule(
+    "DC04", "collective-under-swallowed-exception", "error",
+    "an exception handler continues locally inside a collective-bearing "
+    "protocol — the host that threw skips or re-enters collectives its "
+    "peers are (or will be) waiting in; re-raise on pods, terminate, or "
+    "move the collective out of the exception's reach",
+)
+def check_swallowed_exception(model, config):
+    out = []
+    for fn in sorted(model.reports, key=lambda f: f.qualname):
+        for handler, colls in model.reports[fn].swallow_trys:
+            out.append(finding(
+                DC_RULES["collective-under-swallowed-exception"],
+                fn.module, handler,
+                f"handler in {fn.qualname} swallows the exception while "
+                f"{colls[0]} is in the protocol's reach: a host that "
+                "throws here continues locally while its peers wait in "
+                "the collective; re-raise (at least when "
+                "process_count() > 1) or terminate",
+            ))
+    return out
+
+
+# ---- DC05: raw multihost wait with no bound ----------------------------------
+
+
+@rule(
+    "DC05", "unbounded-distributed-blocking", "error",
+    "a raw multihost primitive (barrier / peer exchange / verdict "
+    "broadcast) runs outside a `collective_phase` region — a peer that "
+    "never arrives is an unnamed forever-hang instead of a "
+    "distributed_wait_timeout with a flight bundle",
+)
+def check_unbounded_blocking(model, config):
+    out = []
+    for fn in sorted(model.facts, key=lambda f: f.qualname):
+        for node, desc, bounded in model.facts[fn].raw_prims:
+            if bounded:
+                continue
+            out.append(finding(
+                DC_RULES["unbounded-distributed-blocking"], fn.module,
+                node,
+                f"{desc} in {fn.qualname} has no bound: wrap the wait in "
+                "`with telemetry.collective_phase(\"<phase>\")` so a "
+                "host that never arrives becomes a named, time-bounded "
+                "hang (distributed_wait_timeout + flight bundle)",
+            ))
+    return out
+
+
+# ---- DC06: collective trip count driven by host-local state ------------------
+
+
+@rule(
+    "DC06", "local-state-collective-count", "error",
+    "a loop whose trip count derives from host-local state (directory "
+    "listing, env, RNG, unbroadcast value) issues collectives — hosts "
+    "disagree on the iteration count and the extra iterations wait "
+    "forever; iterate over a broadcast value instead",
+)
+def check_local_trip_count(model, config):
+    out = []
+    for fn in sorted(model.reports, key=lambda f: f.qualname):
+        for node, reason, colls in model.reports[fn].div_loops:
+            out.append(finding(
+                DC_RULES["local-state-collective-count"], fn.module, node,
+                f"loop in {fn.qualname} is driven by {reason} and issues "
+                f"{colls[0]} each iteration: hosts with divergent local "
+                "state run different collective counts — broadcast the "
+                "work list (broadcast_host0_obj) and iterate over that",
+            ))
+    return out
+
+
+# ---- driver -----------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class DistResult:
+    findings: list
+    files_scanned: int
+
+    @property
+    def unsuppressed(self):
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self):
+        return [f for f in self.findings if f.suppressed]
+
+
+def analyze_modules(modules, config=None, pre_findings=()):
+    """Run every enabled DC rule over parsed modules; suppressions are
+    resolved through each finding's own module (``distcheck:``
+    namespace)."""
+    config = config or DEFAULT_DIST_CONFIG
+    model = DistModel(modules, config)
+    by_path = {m.relpath: m for m in modules}
+    findings = list(pre_findings)
+    for r in DC_RULES.values():
+        if not config.rule_enabled(r.name, r.id):
+            continue
+        findings.extend(r.check(model, config))
+    for f in findings:
+        module = by_path.get(f.path)
+        if module is not None:
+            f.suppressed, f.justification = module.suppression_for(
+                f.rule, f.rule_id, f.line
+            )
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
+    return DistResult(
+        findings=findings, files_scanned=len(modules) + len(pre_findings)
+    )
+
+
+def analyze_paths(paths, config=None):
+    modules, pre = _load_modules(paths, tool="distcheck", error_id="DC00")
+    return analyze_modules(modules, config, pre_findings=pre)
+
+
+def analyze_source(source, name="<snippet>", config=None):
+    """Analyze one in-memory source string (the fixture-test entry point)."""
+    module = ModuleInfo(name, source, relpath=name, tool="distcheck")
+    return analyze_modules([module], config)

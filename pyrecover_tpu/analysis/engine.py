@@ -33,18 +33,24 @@ import tokenize
 from pathlib import Path
 
 # The engine serves more than one analyzer: jaxlint (this package's
-# original tenant) and concur (analysis/concur — the concurrency-safety
-# analyzer) share the parsing, suppression, and marker machinery, each
-# under its own comment namespace (``# jaxlint: ...`` / ``# concur: ...``).
+# original tenant), concur (analysis/concur — the concurrency-safety
+# analyzer), and distcheck (analysis/distcheck — the multi-host
+# collective-congruence analyzer) share the parsing, suppression, and
+# marker machinery, each under its own comment namespace
+# (``# jaxlint: ...`` / ``# concur: ...`` / ``# distcheck: ...``).
 # Directives (disable/disable-next/disable-file) are TOOL-SCOPED: a
 # ModuleInfo parses only its own tool's suppressions, so a jaxlint
-# suppression can never silence a concur finding or vice versa. Markers
-# are parsed for EVERY registered tool — concur's model consumes
-# jaxlint's ``hot-loop``/``host-only`` reachability markers, and jaxlint
-# simply ignores concur's ``guarded-by=<lock>`` declarations.
+# suppression can never silence a concur or distcheck finding, or vice
+# versa in every direction. Markers are parsed for EVERY registered tool
+# — concur's model consumes jaxlint's ``hot-loop``/``host-only``
+# reachability markers, distcheck's model consumes its own
+# ``host-local`` (function returns per-host state) / ``congruent``
+# (function's return agrees across hosts) declarations, and each tool
+# simply ignores the markers it has no meaning for.
 _MARKERS_BY_TOOL = {
     "jaxlint": r"hot-loop|sync-point|host-only",
     "concur": r"guarded-by=[\w.\-]+",
+    "distcheck": r"host-local|congruent",
 }
 
 _DIRECTIVE_RES = {}

@@ -64,7 +64,15 @@ def _leaf_to_numpy(leaf):
     if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
         from jax.experimental import multihost_utils
 
-        return np.asarray(multihost_utils.process_allgather(leaf, tiled=True))
+        from pyrecover_tpu import telemetry
+
+        # pod path: every host must reach this allgather; the bounded
+        # phase makes a host that never arrives a named hang, and the
+        # addressability test is a global array property (congruent)
+        with telemetry.collective_phase("ckpt_leaf_allgather"):
+            return np.asarray(
+                multihost_utils.process_allgather(leaf, tiled=True)
+            )
     return np.asarray(leaf)
 
 

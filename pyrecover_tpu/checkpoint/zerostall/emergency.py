@@ -17,15 +17,16 @@ Semantics:
     hand-off, not a copy. Costs one state-sized slab of host RAM
     (disable with ``$PYRECOVER_EMERGENCY=0``).
   * **Multi-host**: host 0 (the writer) always holds the shadow copy.
-    With ``$PYRECOVER_EMERGENCY_PEER=1`` every host additionally joins a
-    process-group exchange (``multihost_utils.process_allgather`` over
-    the committed leaves, pinned to the CALLING thread like every other
-    collective — it runs inside the next save's blocking window, not the
-    shadow) so each host's RAM holds the full state and a restart can
-    restore from a *peer's* RAM even when the local disk is cold. The
-    exchange rides the ICI allgather because JAX exposes no host-to-host
-    point-to-point primitive; it is opt-in precisely because it moves
-    state-sized bytes.
+    With ``$PYRECOVER_EMERGENCY_PEER=1`` (read on HOST 0 — participation
+    is a host-0 verdict broadcast, never a per-host probe) every host
+    joins a process-group exchange (``multihost_utils.broadcast_one_to_all``
+    over the manifest doc then every committed leaf, pinned to the
+    CALLING thread like every other collective — it runs inside the next
+    save's blocking window, not the shadow) so each host's RAM holds the
+    full state and a restart can restore from a *peer's* RAM even when
+    the local disk is cold. The exchange rides the ICI broadcast because
+    JAX exposes no host-to-host point-to-point primitive; it is opt-in
+    precisely because it moves state-sized bytes.
   * **Strict freshness/digest gate before the tier is ever preferred**:
     the record's step must be at least the newest disk manifest's, the
     saved topology must match the live mesh exactly (elastic restores
@@ -89,30 +90,87 @@ def publish(exp_dir, doc, np_leaves):  # jaxlint: host-only
 
 
 def replicate_to_peers(exp_dir):  # jaxlint: host-only sync-point
-    """Opt-in process-group exchange (``$PYRECOVER_EMERGENCY_PEER=1``):
-    allgather the latest published record's leaves so EVERY host's RAM
-    holds the full state. Collective — must run on the main thread (the
+    """Opt-in process-group exchange (``$PYRECOVER_EMERGENCY_PEER=1``
+    read on HOST 0): broadcast the latest published record — manifest
+    doc first, then every leaf — so EVERY host's RAM holds the full,
+    verifiable state. Collective — must run on the main thread (the
     zerostall engine calls it inside the next save's blocking window).
-    No-op on a single host (the local shadow copy already is the tier)."""
+    No-op on a single host (the local shadow copy already is the tier).
+
+    Congruence protocol (the deadlock this function used to carry):
+    whether the exchange happens is a HOST-0 verdict, broadcast before
+    any payload moves. The old gate read the env var and probed the
+    local record store per host — but only host 0 ever holds a record
+    (``publish`` runs in its writer), so every peer returned early while
+    host 0 sat in ``broadcast_one_to_all`` waiting for participants that
+    had already left: the canonical rank-gated-collective deadlock
+    (distcheck DC01/DC06). Peers now learn the leaf shapes from the
+    broadcast doc, supply placeholder buffers, and install the received
+    record with ``peer_replicated=True`` — which is also what makes
+    ``usable()``'s pod gate passable at all. The whole exchange runs in
+    one bounded ``collective_phase`` (DC05): a host that never arrives
+    becomes a named ``distributed_wait_timeout``, not a silent hang."""
     if jax.process_count() <= 1:
-        return False
-    if os.environ.get(PEER_EXCHANGE_ENV) != "1":
-        return False
-    with _lock:
-        record = _store.get(_key(exp_dir))
-    if record is None or record.get("peer_replicated"):
         return False
     from jax.experimental import multihost_utils
 
-    # host 0 holds the authoritative copy; the broadcast lands it in
-    # every process's RAM (tiled allgather over each leaf)
-    leaves = record["leaves"]
-    replicated = [
-        np.asarray(multihost_utils.broadcast_one_to_all(a)) for a in leaves
-    ]
+    from pyrecover_tpu.checkpoint.vanilla import _dtype_from_str
+    from pyrecover_tpu.parallel.mesh import (
+        broadcast_host0_obj,
+        broadcast_host0_scalar,
+    )
+
+    want = 0
+    record = None
+    if jax.process_index() == 0:
+        if os.environ.get(PEER_EXCHANGE_ENV) == "1":
+            with _lock:
+                record = _store.get(_key(exp_dir))
+            if record is not None and not record.get("peer_replicated"):
+                want = 1
+    if int(broadcast_host0_scalar(want)) != 1:
+        return False
+    # the manifest doc first: peers need the leaf shapes/dtypes to build
+    # their placeholder buffers — and the doc itself, to digest-verify
+    # and restore from the record later
+    doc = broadcast_host0_obj(record["doc"] if record is not None else None)
+    local_leaves = record["leaves"] if record is not None else None
+    replicated = []
+    with telemetry.collective_phase(
+        "emergency_peer_exchange", leaves=len(doc.get("leaves", ())),
+    ):
+        for i, entry in enumerate(doc["leaves"]):
+            # host 0 supplies the payload (want==1 implies it holds the
+            # record); peers supply placeholder buffers whose shape/dtype
+            # come from the broadcast doc, so every host participates in
+            # the SAME leaf sequence regardless of local record state
+            if jax.process_index() == 0:
+                src = local_leaves[i]
+            else:
+                src = np.zeros(
+                    tuple(int(s) for s in entry["shape"]),
+                    dtype=_dtype_from_str(entry["dtype"]),
+                )
+            replicated.append(
+                np.asarray(multihost_utils.broadcast_one_to_all(src))
+            )
+    new_record = {
+        "doc": doc,
+        "leaves": replicated,
+        "step": int(doc.get("step", 0)),
+        "published_ts": (
+            record["published_ts"] if record is not None else time.time()
+        ),
+        "peer_replicated": True,
+    }
     with _lock:
-        record["leaves"] = replicated
-        record["peer_replicated"] = True
+        _store[_key(exp_dir)] = new_record
+    telemetry.emit(
+        "emergency_peer_exchange", engine="zerostall",
+        step=new_record["step"], exp_dir=str(exp_dir),
+        leaves=len(replicated),
+        bytes=int(sum(a.nbytes for a in replicated)),
+    )
     return True
 
 

@@ -150,6 +150,14 @@ def save_ckpt_zerostall(path, state, sampler_state=None, *, verify=False,
     )
     try:
         sync_global_devices("zerostall_save_enter")
+        if emergency_tier:
+            # opt-in peer replication of the PREVIOUS committed snapshot
+            # ($PYRECOVER_EMERGENCY_PEER=1 on host 0): runs here — inside
+            # the blocking window, on the calling thread, reached by
+            # EVERY host on every save — because the exchange is a
+            # collective; the participation verdict is host-0-decided
+            # and broadcast inside (see emergency.replicate_to_peers)
+            emergency.replicate_to_peers(exp_key)
         from pyrecover_tpu.analysis.shardcheck.manifest import state_manifest
 
         schema = state_manifest(state)

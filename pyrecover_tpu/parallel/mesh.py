@@ -388,19 +388,61 @@ def initialize_distributed(coordinator_address=None, num_processes=None,
 
 def sync_global_devices(tag="barrier"):
     """Cross-host barrier (reference `dist.barrier()` call sites, e.g.
-    checkpoint.py:56,103). No-op single-process."""
+    checkpoint.py:56,103). No-op single-process. Bounded: the wait runs
+    inside a ``collective_phase`` so a host that never arrives becomes a
+    named ``distributed_wait_timeout`` + flight bundle, not silence."""
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices(tag)
+        from pyrecover_tpu import telemetry
+
+        with telemetry.collective_phase(f"barrier:{tag}"):
+            multihost_utils.sync_global_devices(tag)
 
 
 def broadcast_host0_scalar(value):
     """Host-0 decides, everyone follows — the stop-flag broadcast pattern
-    (reference `train.py:342-346`). Returns the host-0 value on all hosts."""
+    (reference `train.py:342-346`). Returns the host-0 value on all hosts.
+    This is the SANCTIONED laundering point for host-divergent state:
+    distcheck (DC03/DC06) treats a value that passed through here as
+    congruent across hosts."""
     if jax.process_count() <= 1:
         return value
     from jax.experimental import multihost_utils
 
+    from pyrecover_tpu import telemetry
+
     arr = np.asarray(value)
-    return multihost_utils.broadcast_one_to_all(arr).item()
+    with telemetry.collective_phase("broadcast_host0_scalar"):
+        return multihost_utils.broadcast_one_to_all(arr).item()
+
+
+def broadcast_host0_obj(obj):
+    """Host-0 decides a STRUCTURED value (a candidate list, a manifest
+    doc), everyone follows. JSON round-trip, so the payload must be
+    JSON-serializable; identity single-process.
+
+    Two legs because hosts must NOT need to agree on the payload size up
+    front (that agreement is exactly what's being established): the byte
+    length is broadcast first, then every peer supplies a placeholder
+    buffer of that exact size for the payload broadcast. This is how
+    ``_resume`` pins every host to the SAME checkpoint-candidate walk
+    even when per-host filesystem listings disagree transiently."""
+    if jax.process_count() <= 1:
+        return obj
+    import json as _json
+
+    from jax.experimental import multihost_utils
+
+    from pyrecover_tpu import telemetry
+
+    payload = np.frombuffer(
+        _json.dumps(obj).encode("utf-8"), dtype=np.uint8
+    )
+    with telemetry.collective_phase("broadcast_host0_obj"):
+        n = int(multihost_utils.broadcast_one_to_all(
+            np.asarray(payload.size, dtype=np.int64)
+        ))
+        buf = payload if payload.size == n else np.zeros(n, dtype=np.uint8)
+        data = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    return _json.loads(bytes(data).decode("utf-8"))

@@ -43,6 +43,12 @@ Core event names across the stack (fields beyond the envelope):
                       disk tier bypassed)
     emergency_restore_rejected  reason[, step] (the strict freshness/
                       digest gate refused the RAM record; disk wins)
+    distributed_wait_timeout  phase, timeout_s (a collective_phase-bounded
+                      cross-host wait — barrier / verdict broadcast /
+                      peer RAM exchange — outlived its bound: some host
+                      never reached the collective; a flight bundle is
+                      dumped and doctor reads the open collective_wait
+                      span as collective_hang evidence)
     ckpt_restore_start/ckpt_restore_done  engine, path, seconds
     ckpt_precheck_failed / ckpt_restore_fallback  path, reason
     ckpt_io_retry     op, path, attempt, errno, delay_s (transient-IO retry)
@@ -173,9 +179,10 @@ from pyrecover_tpu.telemetry.sinks import (
     read_events,
     rotated_paths,
 )
-from pyrecover_tpu.telemetry.spans import record_span, span
+from pyrecover_tpu.telemetry.spans import collective_phase, record_span, span
 
 __all__ = [
+    "collective_phase",
     "emit",
     "enabled",
     "add_sink",

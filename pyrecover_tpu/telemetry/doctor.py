@@ -149,8 +149,8 @@ def _last_segment(events):
     return events[start:]
 
 
-def _open_span_stack(events):
-    """Names of span_begin events never matched by a span_end, ordered
+def _open_span_records(events):
+    """span_begin records never matched by a span_end, ordered
     outermost→innermost (span ids are process-monotonic)."""
     open_ = {}
     for e in events:
@@ -159,8 +159,7 @@ def _open_span_stack(events):
             open_[e.get("span")] = e
         elif name == "span_end":
             open_.pop(e.get("span"), None)
-    ordered = sorted(open_.values(), key=lambda r: r.get("span") or 0)
-    return [r.get("name", "?") for r in ordered]
+    return sorted(open_.values(), key=lambda r: r.get("span") or 0)
 
 
 def analyze(evidence, *, recompile_storm_threshold=DEFAULT_RECOMPILE_STORM):
@@ -185,13 +184,12 @@ def analyze(evidence, *, recompile_storm_threshold=DEFAULT_RECOMPILE_STORM):
         findings.append({"kind": kind, "detail": detail})
 
     # -- phase: open spans at death ------------------------------------------
-    phase_stack = []
+    open_records = []
     if newest_bundle and newest_bundle["open_spans"]:
-        phase_stack = [
-            r.get("name", "?") for r in newest_bundle["open_spans"]
-        ]
+        open_records = newest_bundle["open_spans"]
     elif summary is None and seg:
-        phase_stack = _open_span_stack(seg)
+        open_records = _open_span_records(seg)
+    phase_stack = [r.get("name", "?") for r in open_records]
     phase = phase_stack[-1] if phase_stack else None
 
     # -- evidence-derived findings -------------------------------------------
@@ -228,6 +226,30 @@ def analyze(evidence, *, recompile_storm_threshold=DEFAULT_RECOMPILE_STORM):
                 f"resharded {e.get('resharded_leaves')} leaves onto "
                 f"{(e.get('target_topology') or {}).get('devices', '?')} "
                 "devices",
+            )
+    # a hang (or death) whose open span is a collective/broadcast phase
+    # means the run was WAITING ON ITS PEERS: some host never reached
+    # the collective — the cross-host deadlock distcheck exists to
+    # prevent. The collective_wait span's `phase` field (set by
+    # telemetry.collective_phase) names the protocol step.
+    coll_spans = [
+        r for r in open_records if r.get("name") == "collective_wait"
+    ]
+    for r in coll_spans:
+        finding(
+            "collective_hang",
+            f"open collective/broadcast phase '{r.get('phase', '?')}' — "
+            "this host was waiting in a cross-host collective its peers "
+            "never completed",
+        )
+    n_wait_timeouts = counts.get("distributed_wait_timeout", 0)
+    for e in seg:
+        if e.get("event") == "distributed_wait_timeout":
+            finding(
+                "collective_hang",
+                f"phase '{e.get('phase', '?')}' outlived its "
+                f"{e.get('timeout_s', '?')}s bound "
+                "(distributed_wait_timeout)",
             )
     n_hangs = counts.get("hang_detected", 0)
     if n_hangs:
@@ -364,6 +386,7 @@ def analyze(evidence, *, recompile_storm_threshold=DEFAULT_RECOMPILE_STORM):
             "implicit_transfers": n_transfers,
             "platform_fallbacks": n_fallback,
             "hangs": n_hangs,
+            "collective_hangs": len(coll_spans) + n_wait_timeouts,
             "topology_rejections": n_topology,
             "last_status": (summary or {}).get("status"),
         },

@@ -162,6 +162,100 @@ def begin(name, *, metric=None, **fields):  # jaxlint: host-only
     return Span(name, fields, metric=metric)
 
 
+# ---- bounded distributed waits ----------------------------------------------
+
+# Every cross-host wait (barrier, verdict broadcast, peer RAM exchange)
+# runs inside a `collective_phase`: an open `collective_wait` span names
+# the phase (so a hang bundle — and doctor — can say WHICH protocol step
+# never completed) and a daemon timer makes an overrun loud. JAX exposes
+# no way to cancel an in-flight collective, so the timer cannot unstick
+# the wait — it emits `distributed_wait_timeout` and dumps a flight
+# bundle, turning a silent forever-hang into a named, evidenced one.
+# distcheck's DC05 fails any raw multihost primitive OUTSIDE one of
+# these regions.
+COLLECTIVE_TIMEOUT_ENV = "PYRECOVER_COLLECTIVE_TIMEOUT_S"
+DEFAULT_COLLECTIVE_TIMEOUT_S = 600.0
+
+
+def _collective_timeout_s(timeout_s):  # jaxlint: host-only
+    if timeout_s is not None:
+        return float(timeout_s)
+    import os
+
+    raw = os.environ.get(COLLECTIVE_TIMEOUT_ENV)
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return DEFAULT_COLLECTIVE_TIMEOUT_S
+
+
+class _PhaseTimer:
+    """Daemon timer armed for the span of one collective phase."""
+
+    __slots__ = ("timer",)
+
+    def __init__(self, phase, timeout_s, fields):  # jaxlint: host-only
+        def _expired():
+            bus.emit(
+                "distributed_wait_timeout", phase=phase,
+                timeout_s=round(timeout_s, 3), **fields,
+            )
+            from pyrecover_tpu.telemetry import flight
+
+            flight.dump(
+                "distributed_wait_timeout", phase=phase,
+                timeout_s=round(timeout_s, 3),
+            )
+
+        self.timer = threading.Timer(timeout_s, _expired)
+        self.timer.daemon = True
+        self.timer.start()
+
+    def cancel(self):  # jaxlint: host-only
+        self.timer.cancel()
+
+
+class collective_phase:
+    """Context manager bounding one distributed wait.
+
+    ``with collective_phase("emergency_peer_exchange"): ...`` opens a
+    ``collective_wait`` span carrying ``phase=<name>`` and arms a timer
+    (``timeout_s`` arg, else ``$PYRECOVER_COLLECTIVE_TIMEOUT_S``, else
+    600 s). If the body outlives the bound, ``distributed_wait_timeout``
+    is emitted and a flight bundle dumped — the wait itself cannot be
+    cancelled (no JAX API for that), but the hang becomes named evidence
+    instead of silence. ``timeout_s=0`` disables the timer (span only).
+    """
+
+    __slots__ = ("phase", "fields", "_timeout_s", "_span", "_timer")
+
+    def __init__(self, phase, *, timeout_s=None, **fields):  # jaxlint: host-only
+        self.phase = str(phase)
+        self.fields = fields
+        self._timeout_s = _collective_timeout_s(timeout_s)
+        self._span = None
+        self._timer = None
+
+    def __enter__(self):  # jaxlint: host-only
+        self._span = span(
+            "collective_wait", metric="collective_wait_s",
+            phase=self.phase, **self.fields,
+        )
+        self._span.__enter__()
+        if self._timeout_s > 0:
+            self._timer = _PhaseTimer(
+                self.phase, self._timeout_s, self.fields
+            )
+        return self
+
+    def __exit__(self, exc_type, exc, tb):  # jaxlint: host-only
+        if self._timer is not None:
+            self._timer.cancel()
+        return self._span.__exit__(exc_type, exc, tb)
+
+
 # jaxlint: host-only
 def record_span(name, begin_mono, end_mono, *, parent=None, metric=None,
                 **fields):

@@ -300,7 +300,10 @@ def _resume(config, exp_dir, state, sampler, sharded_ckptr, totals):  # jaxlint:
     behind, mid-write, or gone) is never touched. Any gate failure
     falls through to the normal disk walk silently; a record that
     passes the gate but fails mid-restore falls back loudly
-    (``emergency_restore_rejected``).
+    (``emergency_restore_rejected``) single-process, and RAISES on a
+    pod — the broadcast verdict already committed every host to the RAM
+    path, so one host privately rejoining the disk walk would leave its
+    verdict collectives one participant short (deadlock).
     """
     from pyrecover_tpu.checkpoint import elastic, precheck_ckpt_sharded
     from pyrecover_tpu.checkpoint.elastic import TopologyMismatchError
@@ -314,6 +317,7 @@ def _resume(config, exp_dir, state, sampler, sharded_ckptr, totals):  # jaxlint:
         precheck_ckpt_zerostall,
     )
     from pyrecover_tpu.parallel.mesh import (
+        broadcast_host0_obj,
         broadcast_host0_scalar,
         state_topology,
     )
@@ -325,12 +329,29 @@ def _resume(config, exp_dir, state, sampler, sharded_ckptr, totals):  # jaxlint:
     if explicit:
         candidates = [target]
     else:
-        candidates = list_checkpoints(exp_dir, engine=engine)[::-1]
-        if not candidates and not (
-            engine == "zerostall" and emergency.peek(exp_dir) is not None
-        ):
-            log_host0("No checkpoint found in %s; starting fresh", exp_dir)
-            return 0, state
+        # every host must walk the SAME candidate list: the per-candidate
+        # verdict broadcasts below are positional, so transiently
+        # divergent per-host directory listings (host 0 mid-quarantine,
+        # shared-FS stragglers) would have hosts exchanging verdicts
+        # about DIFFERENT checkpoints. Host 0's listing is authoritative.
+        candidates = broadcast_host0_obj(
+            [str(p) for p in list_checkpoints(exp_dir, engine=engine)[::-1]]
+        )
+        if not candidates:
+            # the "anything at all to restore?" decision must also be
+            # congruent: only host 0 ever holds an emergency record, so a
+            # per-host peek here would send host 0 into the use_ram
+            # broadcast below while every peer had already returned fresh
+            have_ram = 0
+            if engine == "zerostall":
+                if jax.process_index() == 0:
+                    have_ram = int(emergency.peek(exp_dir) is not None)
+                have_ram = int(broadcast_host0_scalar(have_ram))
+            if not have_ram:
+                log_host0(
+                    "No checkpoint found in %s; starting fresh", exp_dir
+                )
+                return 0, state
 
     # ---- in-RAM emergency tier (zerostall, "latest" only) ------------------
     # host-0 gate: fresh enough (>= newest disk manifest), same topology,
@@ -365,6 +386,15 @@ def _resume(config, exp_dir, state, sampler, sharded_ckptr, totals):  # jaxlint:
                     "emergency_restore_rejected",
                     reason=f"{type(e).__name__}: {e}",
                 )
+                if jax.process_count() > 1:
+                    # the use_ram verdict already committed EVERY host to
+                    # the RAM path; one host silently falling through to
+                    # the disk walk (and its per-candidate verdict
+                    # broadcasts) while the others return resumed would
+                    # leave those collectives one participant short
+                    # forever. A pod fails loudly here — same discipline
+                    # as the disk-path restore handler below.
+                    raise
                 log_host0(
                     "emergency-tier restore failed (%s: %s); falling back "
                     "to the disk tier", type(e).__name__, e, level=30,
@@ -551,7 +581,16 @@ def _resume(config, exp_dir, state, sampler, sharded_ckptr, totals):  # jaxlint:
         if elastic_active:
             # the reshard happened: account for it in the event stream.
             # Plan accounting exists on host 0 (where the gate ran); the
-            # event is host-stamped like every other emit.
+            # whole block — including the sampler-rescale validation
+            # round-trip, whose result is advisory — is host-0-local
+            # telemetry with no collectives, so it nests entirely under
+            # the rank gate instead of leaking the unbroadcast
+            # ``live_replicas`` into all-host control flow (distcheck
+            # DC03). The actual data-pipeline rescale needs no per-host
+            # work at all: the sampler's order is a pure function of
+            # (seed, epoch, cursor), so the global ``seek`` below
+            # re-derives every replica's split exactly — proven by the
+            # merge/split round-trip (preflight established feasibility).
             if jax.process_index() == 0 and plan is not None:
                 telemetry.emit(
                     "elastic_resume", path=str(cand), step=start_step,
@@ -560,33 +599,30 @@ def _resume(config, exp_dir, state, sampler, sharded_ckptr, totals):  # jaxlint:
                     resharded_leaves=plan.resharded_leaves,
                     plan_bytes_moved=plan.bytes_moved,
                 )
-            # data-pipeline rescale: the sampler's order is a pure
-            # function of (seed, epoch, cursor), so re-deriving the
-            # per-replica split for the new replica count preserves the
-            # global sample sequence exactly — proven by the merge/split
-            # round-trip (preflight already established feasibility)
-            saved_replicas = int(sampler_meta.get("replicas", 0) or 0)
-            live_replicas = 0
-            if jax.process_index() == 0 and plan is not None:
+                saved_replicas = int(sampler_meta.get("replicas", 0) or 0)
                 tgt_mesh = plan.target_topology.get("mesh") or {}
                 live_replicas = int(tgt_mesh.get("data", 1)) * int(
                     tgt_mesh.get("fsdp", 1)
                 )
-            if saved_replicas and live_replicas and (
-                saved_replicas != live_replicas
-            ):
-                from pyrecover_tpu.data.sampler import rescale_sampler_state
+                if saved_replicas and live_replicas and (
+                    saved_replicas != live_replicas
+                ):
+                    from pyrecover_tpu.data.sampler import (
+                        rescale_sampler_state,
+                    )
 
-                rescale_sampler_state(
-                    {k: v for k, v in sampler_meta.items()
-                     if k not in ("consumed", "replicas")},
-                    live_replicas,
-                )
-                telemetry.emit(
-                    "sampler_rescaled", saved_replicas=saved_replicas,
-                    target_replicas=live_replicas,
-                    consumed=int(sampler_meta.get("consumed", start_step)),
-                )
+                    rescale_sampler_state(
+                        {k: v for k, v in sampler_meta.items()
+                         if k not in ("consumed", "replicas")},
+                        live_replicas,
+                    )
+                    telemetry.emit(
+                        "sampler_rescaled", saved_replicas=saved_replicas,
+                        target_replicas=live_replicas,
+                        consumed=int(
+                            sampler_meta.get("consumed", start_step)
+                        ),
+                    )
         sampler.seek(sampler_meta.get("consumed", start_step))
         totals.ckpt_load_s += time.monotonic() - t0
         log_host0(

@@ -107,6 +107,11 @@ def modelled_total_bytes(model_config, mesh_shape, *, batch_size, seq_len,
     return int(rows["total_bytes"])
 
 
+# The $PYRECOVER_DEVICE_KIND env override below is a fleet-uniform launch
+# contract (the PR 7 elastic-preflight convention): every host of one job
+# is launched with the same value, so the resolved policy is identical
+# everywhere — which is what the congruence marker declares.
+# distcheck: congruent -- config + fleet-uniform $PYRECOVER_DEVICE_KIND only
 def resolve_remat_policy(model_config, mesh_shape, *, batch_size, seq_len,
                          loss_chunk_size=0, optimizer_sharding="none",
                          grad_allreduce="fp32", quant_block=256,

@@ -166,6 +166,93 @@ def mode_moe_ep(proc_id, workdir):
     }
 
 
+def mode_emergency_peer(proc_id, workdir):
+    """The fixed DC01/DC05 finding, on a REAL 2-process group: the
+    emergency peer RAM exchange with ``$PYRECOVER_EMERGENCY_PEER=1`` set
+    on HOST 0 ONLY. Before the fix, the per-host env/record gate sent
+    host 1 home while host 0 sat in ``broadcast_one_to_all`` forever —
+    the canonical rank-gated-collective deadlock, which this harness
+    bounds with its subprocess timeout (the hang watchdog). After the
+    fix the participation verdict is host-0-decided and broadcast, so
+    BOTH hosts run the exchange, and host 1's RAM ends up holding a
+    record whose chunk digests verify against the committed manifest —
+    byte-equality with host 0's published snapshot, by construction."""
+    import hashlib
+    from pathlib import Path
+
+    import numpy as np
+
+    from pyrecover_tpu.checkpoint import checkpoint_path, save_ckpt_zerostall
+    from pyrecover_tpu.checkpoint.zerostall import emergency
+    from pyrecover_tpu.config import TrainConfig
+    from pyrecover_tpu.models import ModelConfig
+    from pyrecover_tpu.optim import build_optimizer
+    from pyrecover_tpu.parallel.mesh import (
+        MeshConfig,
+        create_mesh,
+        state_topology,
+        sync_global_devices,
+    )
+    from pyrecover_tpu.train import init_sharded_state
+
+    # the smoke mode's mesh shape: tensor=2 keeps a sharded axis inside
+    # each host (pure cross-process replication is unsupported on the
+    # virtual CPU backend), data spans the two processes — so the saved
+    # leaves exercise the non-addressable allgather path too
+    mesh = create_mesh(MeshConfig(data=jax.device_count() // 2, tensor=2))
+    model_cfg = ModelConfig(
+        dim=64, n_layers=2, n_heads=4, n_kv_heads=2, vocab_size=128,
+        multiple_of=32, max_seq_len=32,
+    )
+    cfg = TrainConfig(sequence_length=32, batch_size=8, training_samples=64,
+                      learning_rate=1e-3)
+    cfg.model = model_cfg
+    cfg.__post_init__()
+    optimizer, _ = build_optimizer(cfg)
+    state = init_sharded_state(jax.random.key(3), cfg.model, optimizer, mesh)
+
+    exp = Path(workdir) / "ep"
+    path = checkpoint_path(str(exp.parent), "ep", 3, engine="zerostall")
+    save_ckpt_zerostall(
+        path, state, {"consumed": 3}, background=False,
+        extra_meta={"step": 3},
+    )
+    sync_global_devices("post_save")
+
+    # the deadlock seed: only host 0 opts in; only host 0 holds a record
+    if proc_id == 0:
+        os.environ[emergency.PEER_EXCHANGE_ENV] = "1"
+    did = emergency.replicate_to_peers(str(exp))
+
+    got = emergency.peek(str(exp))
+    verified, why = (
+        emergency.verify(got[1]) if got is not None else (False, "no record")
+    )
+    usable = emergency.usable(
+        str(exp), state_topology(state), min_step=0
+    ) is not None
+    digests = []
+    if got is not None:
+        for leaf in got[1]["leaves"]:
+            digests.append(hashlib.blake2b(
+                np.ascontiguousarray(leaf).tobytes(), digest_size=8
+            ).hexdigest())
+    # a second call must be a congruent no-op on every host (the record
+    # is already peer_replicated)
+    again = emergency.replicate_to_peers(str(exp))
+    sync_global_devices("post_exchange")
+    return {
+        "did": bool(did),
+        "again": bool(again),
+        "has_record": got is not None,
+        "verified": bool(verified),
+        "verify_reason": why,
+        "usable": bool(usable),
+        "step": int(got[0]) if got is not None else -1,
+        "digests": digests,
+    }
+
+
 def main():
     proc_id = int(sys.argv[1])
     num_procs = int(sys.argv[2])
@@ -189,6 +276,8 @@ def main():
             result = mode_resume(proc_id, workdir, sharded=True)
         elif mode == "moe_ep":
             result = mode_moe_ep(proc_id, workdir)
+        elif mode == "emergency_peer":
+            result = mode_emergency_peer(proc_id, workdir)
         else:
             raise SystemExit(f"unknown mode {mode}")
         result["proc"] = proc_id

@@ -80,6 +80,54 @@ def test_closed_spans_do_not_name_a_phase(tmp_path):
     assert rep["phase"] is None
 
 
+def test_hang_in_collective_phase_gains_collective_hang_evidence(tmp_path):
+    """A hang whose open span is a collective/broadcast phase (every
+    cross-host wait runs inside telemetry.collective_phase) is a
+    CROSS-HOST deadlock, not a local stall: the report gains
+    collective_hang evidence naming the protocol phase — the distcheck
+    DC01 failure mode, made diagnosable from artifacts."""
+    root = exp_with(tmp_path, [
+        RUN_START,
+        {"event": "span_begin", "span": 7, "name": "collective_wait",
+         "phase": "emergency_peer_exchange"},
+        {"event": "hang_detected", "silent_s": 12.0},
+    ])
+    rep = doctor.diagnose(root)
+    assert rep["classification"] == "hang"
+    assert rep["phase"] == "collective_wait"
+    kinds = {f["kind"] for f in rep["findings"]}
+    assert "collective_hang" in kinds
+    (ch,) = [f for f in rep["findings"] if f["kind"] == "collective_hang"]
+    assert "emergency_peer_exchange" in ch["detail"]
+    assert rep["evidence"]["collective_hangs"] == 1
+
+
+def test_wait_timeout_event_feeds_collective_hang_evidence(tmp_path):
+    root = exp_with(tmp_path, [
+        RUN_START,
+        {"event": "distributed_wait_timeout",
+         "phase": "barrier:zerostall_save_enter", "timeout_s": 600},
+    ])
+    rep = doctor.diagnose(root)
+    (ch,) = [f for f in rep["findings"] if f["kind"] == "collective_hang"]
+    assert "barrier:zerostall_save_enter" in ch["detail"]
+    assert rep["evidence"]["collective_hangs"] >= 1
+
+
+def test_non_collective_hang_has_no_collective_evidence(tmp_path):
+    root = exp_with(tmp_path, [
+        RUN_START,
+        {"event": "span_begin", "span": 3, "name": "loader_wait"},
+        {"event": "hang_detected", "silent_s": 9.0},
+    ])
+    rep = doctor.diagnose(root)
+    assert rep["classification"] == "hang"
+    assert not [
+        f for f in rep["findings"] if f["kind"] == "collective_hang"
+    ]
+    assert rep["evidence"]["collective_hangs"] == 0
+
+
 def test_hang_even_when_run_later_finished(tmp_path):
     root = exp_with(tmp_path, [
         RUN_START,

@@ -1,0 +1,25 @@
+#!/usr/bin/env python
+"""distcheck CLI — static multi-host collective-congruence analysis.
+
+Usage:
+    python tools/distcheck.py pyrecover_tpu/ --strict
+    python tools/distcheck.py --list-rules
+    python tools/distcheck.py pyrecover_tpu/ --json /tmp/distcheck.json
+
+All logic lives in ``pyrecover_tpu.analysis.distcheck`` (host-divergence
+model in ``model.py``, rules DC01–DC06 in ``rules.py``, suppression
+syntax shared with jaxlint/concur under the ``distcheck:`` comment
+namespace); this file is the executable shim so the analyzer is runnable
+before the package is installed.
+"""
+
+import sys
+from pathlib import Path
+
+# runnable from any cwd, installed or not
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from pyrecover_tpu.analysis.distcheck.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
