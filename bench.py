@@ -662,6 +662,27 @@ def main():
             ck["ckpt_blocking_total_s"] = round(
                 blocking_s + write_s + b1 + b2, 4
             )
+            # the operational dial: Young-Daly optimal save cadence for
+            # the measured per-save blocking cost of each engine across
+            # an MTTI ladder (the goodput autopilot computes the same
+            # quantity online from the live failure model — this is the
+            # static planning table for operators reading BENCH JSON)
+            from pyrecover_tpu.resilience.autopilot import (
+                young_daly_interval_s,
+            )
+
+            ck["young_daly_interval_s"] = {
+                engine_name: {
+                    f"mtti_{mtti_s}s": round(
+                        young_daly_interval_s(cost, mtti_s), 1
+                    )
+                    for mtti_s in (1800, 7200, 28800)
+                }
+                for engine_name, cost in (
+                    ("vanilla", d2h_s + write_s),
+                    ("zerostall", min(b1, b2)),
+                )
+            }
             if args.write_ckpt_baseline:
                 # traceview-format {phase_key: p50_s}: the vanilla full
                 # save vs the zerostall blocking window, ON THE SAME

@@ -126,6 +126,27 @@ else
   rc=1
 fi
 
+# goodput-autopilot summarizer gate: the chaos soak's autopilot drill
+# (cycles 25+ — seeded hazard-rate kills with a mid-run rate shift,
+# --checkpoint-frequency auto) just produced a ckpt_policy decision trail;
+# summarize_telemetry must render the "checkpoint policy (autopilot)"
+# section AND the goodput-vs-static counterfactual ("static policy ...
+# would have lost X s") from that same stream — the convergence/sidecar/
+# no-quarantine verdicts themselves are gated inside the chaos report.
+if AP_SUM=$(JAX_PLATFORMS=cpu python tools/summarize_telemetry.py \
+    "$CHAOS_WORK"/ap/ap_telemetry.jsonl 2>&1); then
+  if echo "$AP_SUM" | grep -q "checkpoint policy (autopilot)" \
+      && echo "$AP_SUM" | grep -q "static policy"; then
+    echo "$AP_SUM" | grep -A 5 "checkpoint policy (autopilot)" | head -6
+  else
+    echo "summarize_telemetry: autopilot decision-trail or goodput-vs-static section missing"
+    rc=1
+  fi
+else
+  echo "$AP_SUM"
+  rc=1
+fi
+
 # traceview smoke: the tracing stack's gate (pyrecover_tpu/telemetry).
 # Merges the chaos soak's telemetry shards (the interrupted run + the
 # golden run — rotation-split JSONL included), exports Chrome-trace-event

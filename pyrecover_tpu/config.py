@@ -97,7 +97,26 @@ class TrainConfig:
     distributed: bool = False  # demand a multi-host rendezvous (hard-fail without one)
     # -- checkpointing -------------------------------------------------------
     checkpoint_dir: str = "checkpoints/"
-    checkpoint_frequency: int = 10  # -1 disables (reference utils.py semantics)
+    # save every k steps; any value < 1 disables periodic saves and is
+    # normalized to the canonical -1 in __post_init__ (the docs used to
+    # say "-1 disables" while train.py gated on > 0, so 0 and other
+    # negatives silently disabled too — now they disable LOUDLY). The CLI
+    # also accepts --checkpoint-frequency auto (checkpoint_auto below):
+    # the goodput autopilot then adapts the interval online and this
+    # value only serves as the static baseline for the counterfactual
+    checkpoint_frequency: int = 10
+    # telemetry-driven adaptive cadence (resilience/autopilot.py): compute
+    # the Young-Daly optimal save interval online from the observed
+    # per-save blocking cost and the interruption rate persisted in the
+    # failure-history sidecar; bounded by the floor/ceiling below, with
+    # hysteresis so one outlier cannot thrash the cadence
+    checkpoint_auto: bool = False
+    ckpt_auto_floor: int = 1  # hard minimum interval (steps)
+    ckpt_auto_ceiling: int = 500  # hard maximum interval (steps)
+    # MTTI assumed while ZERO interruptions have been observed (the
+    # bounded prior the interval degrades to — saves are never disabled)
+    ckpt_auto_mtti_prior_s: float = 3600.0
+    ckpt_auto_window: int = 8  # interruptions in the windowed MTTI estimate
     resume_from_checkpoint: Optional[str] = None  # path | "latest"
     experiment_name: str = "default-exp"
     verify_checkpoints: bool = False
@@ -211,6 +230,39 @@ class TrainConfig:
                     "(+zero1) only; fsdp/tensor/expert axes already "
                     "shard their own collectives — drop it with them"
                 )
+        # normalize the disable sentinel: the docs promise "-1 disables",
+        # and train.py gates on > 0 — so 0 and other negatives used to
+        # disable silently. Any value < 1 now canonicalizes to -1 with a
+        # loud one-time note, so "my checkpoints never saved" is always
+        # diagnosable from the log.
+        if self.checkpoint_frequency < 1:
+            if self.checkpoint_frequency != -1:
+                import logging
+
+                logging.getLogger("pyrecover_tpu").warning(
+                    "--checkpoint-frequency %d disables periodic "
+                    "checkpoints (any value < 1 does; normalized to -1)",
+                    self.checkpoint_frequency,
+                )
+            self.checkpoint_frequency = -1
+        if self.ckpt_auto_floor < 1:
+            raise ValueError(
+                f"--ckpt-auto-floor must be >= 1, got {self.ckpt_auto_floor}"
+            )
+        if self.ckpt_auto_ceiling < self.ckpt_auto_floor:
+            raise ValueError(
+                f"--ckpt-auto-ceiling {self.ckpt_auto_ceiling} must be >= "
+                f"--ckpt-auto-floor {self.ckpt_auto_floor}"
+            )
+        if self.ckpt_auto_mtti_prior_s <= 0:
+            raise ValueError(
+                "--ckpt-auto-mtti-prior must be positive, got "
+                f"{self.ckpt_auto_mtti_prior_s}"
+            )
+        if self.ckpt_auto_window < 1:
+            raise ValueError(
+                f"--ckpt-auto-window must be >= 1, got {self.ckpt_auto_window}"
+            )
         # engine resolution: the explicit --checkpoint-engine wins; the
         # legacy --sharded-checkpoint boolean is kept in sync because the
         # sharded-specific machinery (Orbax checkpointer) keys off it
@@ -255,6 +307,20 @@ class TrainConfig:
                 if self.pp_virtual_stages is not None
                 else self.model.pp_virtual_stages
             ),
+        )
+
+
+def _checkpoint_frequency_arg(value):
+    """``--checkpoint-frequency`` accepts an int (every k steps; < 1
+    disables) or the literal ``auto`` (goodput autopilot adapts it)."""
+    v = str(value).strip().lower()
+    if v == "auto":
+        return "auto"
+    try:
+        return int(v)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected an integer or 'auto', got {value!r}"
         )
 
 
@@ -401,7 +467,32 @@ def build_parser():
 
     # checkpointing (utils.py:190-232)
     p.add_argument("--checkpoint-dir", type=str, default=d.checkpoint_dir)
-    p.add_argument("--checkpoint-frequency", type=int, default=d.checkpoint_frequency)
+    p.add_argument("--checkpoint-frequency", type=_checkpoint_frequency_arg,
+                   default=d.checkpoint_frequency,
+                   help="save every k steps (< 1 disables), or 'auto': the "
+                        "goodput autopilot adapts the interval online to "
+                        "the Young-Daly optimum computed from the measured "
+                        "per-save blocking cost and the interruption rate "
+                        "in the failure-history sidecar (bounded by "
+                        "--ckpt-auto-floor/--ckpt-auto-ceiling; decisions "
+                        "emitted as ckpt_policy telemetry).")
+    p.add_argument("--ckpt-auto-floor", type=int, default=d.ckpt_auto_floor,
+                   help="autopilot: hard minimum save interval in steps.")
+    p.add_argument("--ckpt-auto-ceiling", type=int,
+                   default=d.ckpt_auto_ceiling,
+                   help="autopilot: hard maximum save interval in steps "
+                        "(also the bounded-prior cadence while no "
+                        "interruption has been observed).")
+    p.add_argument("--ckpt-auto-mtti-prior", type=float,
+                   dest="ckpt_auto_mtti_prior_s",
+                   default=d.ckpt_auto_mtti_prior_s,
+                   help="autopilot: assumed MTTI (seconds) while zero "
+                        "interruptions have been observed.")
+    p.add_argument("--ckpt-auto-window", type=int,
+                   default=d.ckpt_auto_window,
+                   help="autopilot: number of recent interruptions in the "
+                        "windowed MTTI estimate (a mid-run failure-rate "
+                        "shift is tracked within this many failures).")
     p.add_argument("--resume-from-checkpoint", type=str, default=None)
     p.add_argument("--experiment_name", "--experiment-name", dest="experiment_name",
                    type=str, default=d.experiment_name)
@@ -537,7 +628,18 @@ def get_args(argv=None):
         pp_virtual_stages=ns.pp_virtual_stages,
         distributed=ns.distributed,
         checkpoint_dir=ns.checkpoint_dir,
-        checkpoint_frequency=ns.checkpoint_frequency,
+        # "auto" keeps the numeric default as the static-counterfactual
+        # baseline (and the autopilot's rate-limit starting point)
+        checkpoint_frequency=(
+            TrainConfig.checkpoint_frequency
+            if ns.checkpoint_frequency == "auto"
+            else ns.checkpoint_frequency
+        ),
+        checkpoint_auto=ns.checkpoint_frequency == "auto",
+        ckpt_auto_floor=ns.ckpt_auto_floor,
+        ckpt_auto_ceiling=ns.ckpt_auto_ceiling,
+        ckpt_auto_mtti_prior_s=ns.ckpt_auto_mtti_prior_s,
+        ckpt_auto_window=ns.ckpt_auto_window,
         resume_from_checkpoint=ns.resume_from_checkpoint,
         experiment_name=ns.experiment_name,
         verify_checkpoints=ns.verify_checkpoints,

@@ -15,8 +15,10 @@ Re-designed for TPU:
     watcher (``maintenance.py``) long-polling the GCE metadata server for
     TERMINATE/preemption announcements that never arrive as signals.
   * Adaptive safety buffer: thresholds start from ``--default-iter-time`` /
-    ``--default-ckpt-time`` and track observed maxima (reference
-    train.py:298-307, 334-337). The reference's two inconsistent buffer
+    ``--default-ckpt-time`` and track a decaying high quantile of the
+    observed durations, with the recent-window max as a floor (reference
+    train.py:298-307, 334-337 tracked raw maxima, so one compile-step
+    outlier inflated the buffer forever). The reference's two inconsistent buffer
     formulas (init 10·iter+2·ckpt vs steady 5·iter+1·ckpt — SURVEY §2.3
     defect 9) are collapsed to one: ``5·iter + 2·ckpt``.
   * Decision protocol: host 0 decides, the decision is broadcast to every
@@ -33,6 +35,7 @@ launcher (launch/run_resilient.sh) uses to decide whether to restart with
 import os
 import signal
 import time
+from collections import deque
 from pathlib import Path
 
 import jax
@@ -44,6 +47,41 @@ from pyrecover_tpu.utils.logging import log_host0
 PREEMPT_NOTICE_ENV = "PYRECOVER_PREEMPT_FILE"
 REQUEUE_MARKER = "REQUEUE"
 DONE_MARKER = "DONE"
+
+
+class DecayingMaxEstimator:
+    """Decaying high-quantile estimate of a duration stream, with the true
+    max over a short recent window kept as a floor.
+
+    The old estimator here was max-only: ONE compile-step or straggler
+    outlier permanently inflated the safety buffer for the rest of the
+    job (an always-too-early final checkpoint is wasted walltime every
+    single run). This keeps the safety property — the estimate never
+    drops below anything seen in the last ``window`` observations, so a
+    genuine slowdown holds the buffer up — while the decayed peak
+    (``peak = max(obs, peak·decay)`` per observation) lets a one-off
+    outlier relax back toward the live regime instead of sticking
+    forever. Before any observation the estimate is the configured
+    default (the prior the reference's ``--default-iter-time`` /
+    ``--default-ckpt-time`` flags encode)."""
+
+    def __init__(self, initial, decay=0.9, window=8):
+        self._initial = float(initial)
+        self._decay = float(decay)
+        self._peak = float(initial)
+        self._recent = deque(maxlen=int(window))
+
+    def observe(self, seconds):
+        seconds = float(seconds)
+        self._peak = max(seconds, self._peak * self._decay)
+        self._recent.append(seconds)
+        return self.value
+
+    @property
+    def value(self):
+        if not self._recent:
+            return self._initial
+        return max(self._peak, max(self._recent))
 
 
 def get_job_end_time(explicit=None):
@@ -68,8 +106,8 @@ class PreemptionWatcher:
                  notice_file=None, check_interval=1):
         self.enabled = enabled
         self.job_end_time = get_job_end_time(job_end_time)
-        self.max_iter_time = float(default_iter_time)
-        self.max_ckpt_time = float(default_ckpt_time)
+        self._iter_estimate = DecayingMaxEstimator(default_iter_time)
+        self._ckpt_estimate = DecayingMaxEstimator(default_ckpt_time)
         # the deadline/notice check runs every k-th step (a forced device
         # sync + cross-host broadcast would otherwise tax EVERY step); the
         # threshold absorbs the ≤(k-1)-step decision delay
@@ -98,26 +136,38 @@ class PreemptionWatcher:
                 )
 
     # -- online learning of durations (reference train.py:298-307, 334-337) --
+    # The estimators are decaying high-quantile trackers, not raw maxima:
+    # one compile-step/straggler outlier relaxes back out of the safety
+    # buffer instead of inflating it for the rest of the job (the recent-
+    # window max floor keeps genuine slowdowns fully covered).
     def observe_iter(self, seconds):
-        if seconds > self.max_iter_time:
-            self.max_iter_time = seconds
-            if self.enabled:
-                # only on increases, so the event stream stays bounded
-                telemetry.emit(
-                    "preempt_estimate", kind="iter",
-                    seconds=round(seconds, 4),
-                    safety_buffer_s=round(self.safety_buffer, 4),
-                )
+        prev = self._iter_estimate.value
+        val = self._iter_estimate.observe(seconds)
+        if val > prev and self.enabled:
+            # only on increases, so the event stream stays bounded
+            telemetry.emit(
+                "preempt_estimate", kind="iter",
+                seconds=round(val, 4),
+                safety_buffer_s=round(self.safety_buffer, 4),
+            )
 
     def observe_ckpt(self, seconds):
-        if seconds > self.max_ckpt_time:
-            self.max_ckpt_time = seconds
-            if self.enabled:
-                telemetry.emit(
-                    "preempt_estimate", kind="ckpt",
-                    seconds=round(seconds, 4),
-                    safety_buffer_s=round(self.safety_buffer, 4),
-                )
+        prev = self._ckpt_estimate.value
+        val = self._ckpt_estimate.observe(seconds)
+        if val > prev and self.enabled:
+            telemetry.emit(
+                "preempt_estimate", kind="ckpt",
+                seconds=round(val, 4),
+                safety_buffer_s=round(self.safety_buffer, 4),
+            )
+
+    @property
+    def max_iter_time(self):
+        return self._iter_estimate.value
+
+    @property
+    def max_ckpt_time(self):
+        return self._ckpt_estimate.value
 
     @property
     def safety_buffer(self):

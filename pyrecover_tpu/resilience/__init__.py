@@ -6,6 +6,7 @@ a reproducible input, not a hope. This package holds the three pieces:
   * ``faults`` — a seeded, declarative fault-injection engine. A fault
     plan (JSON via ``$PYRECOVER_FAULT_PLAN`` or ``faults.install``) maps
     fault specs (``sigterm_at_step``, ``kill9_during_save``,
+    ``random_sigkill`` — a seeded per-step hazard rate,
     ``corrupt_ckpt_bytes``, ``transient_io_error``, ``loader_stall``,
     ``metadata_flap``) onto explicit injection *seams*
     (``faults.check(site, **ctx)``) threaded through the checkpoint
@@ -21,6 +22,13 @@ a reproducible input, not a hope. This package holds the three pieces:
 ``tools/chaos.py`` (module ``resilience.chaos``) is the soak harness that
 kills/corrupts/resumes a real tiny-model trainer under a seeded plan and
 asserts bit-exact stitched-loss continuity against an uninterrupted run.
+
+``autopilot`` closes the measurement → policy loop: the goodput autopilot
+(``--checkpoint-frequency auto``) estimates the per-save blocking cost
+and the interruption rate (from the ``failure_history.json`` sidecar
+reconstructed over the resume chain) and adapts the checkpoint interval
+to the Young–Daly optimum online, emitting every decision as a
+``ckpt_policy`` telemetry event.
 """
 
 from pyrecover_tpu.resilience import faults

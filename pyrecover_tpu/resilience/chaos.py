@@ -58,6 +58,17 @@ A smoke soak is four trainer runs over one experiment directory::
                BIT-EXACT end to end: a per-bucket fp32 psum is an exact
                elementwise sum, so the bucket layout can change across
                a resume without touching the trajectory at all.
+    cycles 25+: goodput-autopilot drill (own exp dirs) — a golden run with
+               --checkpoint-frequency auto and no faults (must hold the
+               bounded prior: constant ceiling interval, saves never
+               disabled), then a run under a seeded random_sigkill hazard
+               whose rate SHIFTS mid-run (AP_RATE until step AP_SHIFT,
+               zero after), resumed until it finishes; gated on the
+               adapted interval landing within 2x of the analytic
+               Young-Daly optimum on both sides of the shift, the
+               ckpt_policy decision trail appearing in every run segment,
+               the failure-history sidecar counting exactly the observed
+               kills, and zero quarantines.
 
 Verdicts: per-cycle exit codes, stitched CSV == golden CSV, exactly the
 injected corruption quarantined (zero non-injected losses), and the
@@ -195,6 +206,25 @@ def _schedule(preset, seed):
     return s1, s2
 
 
+# goodput-autopilot drill shape: AP_STEPS total steps, the seeded
+# random_sigkill hazard active on global steps [0, AP_SHIFT) at AP_RATE
+# per eligible step (then zero — the mid-run rate shift), AP_GRACE
+# hazard-free steps after every process start (> AP_CEILING, the
+# liveness-by-construction bound), and the controller clamped to
+# [1, AP_CEILING] so the analytic optimum sits interior to the bounds at
+# tiny-model CPU timings. The drill typically runs: golden + kill at
+# ~step 14-16 + kill at ~step 26-27 + a clean finish.
+AP_STEPS = 44
+AP_SHIFT = 32
+AP_RATE = 0.7
+AP_GRACE = 13
+AP_CEILING = 12
+AP_MAX_ATTEMPTS = 12
+# convergence gate: the chosen interval must land within this factor of
+# the bound-clamped analytic Young–Daly optimum recomputed from the
+# decision's own reported inputs (cost, MTTI, step time)
+AP_CONVERGENCE_FACTOR = 2.0
+
 # relative per-step loss tolerance for the post-shrink segment of the
 # elastic drill: a changed replica count changes the cross-device
 # reduction order (and per-replica batch composition), so the float
@@ -278,9 +308,11 @@ def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
     cycles = []
 
     def cycle(name, *, fault_plan, resume, expect_rc, exp="chaos",
-              extra_args=(), device_count=None, sync_ckpt=True):
-        cmd = _trainer_cmd(preset, exp, seed, workdir, resume=resume,
-                           extra_args=extra_args, sync_ckpt=sync_ckpt)
+              extra_args=(), device_count=None, sync_ckpt=True,
+              preset_over=None):
+        cmd = _trainer_cmd(preset_over or preset, exp, seed, workdir,
+                           resume=resume, extra_args=extra_args,
+                           sync_ckpt=sync_ckpt)
         try:
             rc, secs = _run_trainer(
                 cmd, fault_plan=fault_plan, log_path=log_path,
@@ -453,6 +485,55 @@ def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
     cycle("bkf_flip_resume@newlayout", resume=True, expect_rc=(0,),
           exp="bkf", device_count=2,
           extra_args=("--grad-bucket-mb", "0.2"), fault_plan=None)
+
+    # cycles 25+ — goodput-autopilot drill (own exp dirs): the closed loop
+    # measurement → failure model → Young–Daly policy → actuation, proven
+    # against a seeded hazard-rate kill schedule whose rate SHIFTS mid-run
+    # (rate AP_RATE for global steps < AP_SHIFT, zero after — maintenance
+    # ended). A golden run with --checkpoint-frequency auto and no faults
+    # pins the graceful zero-failure posture (bounded prior, never
+    # thrashes, never disables saves); the faulted run is resumed until it
+    # finishes, and the gates below assert the ckpt_policy decision trail
+    # survives every kill via the failure-history sidecar and lands within
+    # 2× of the analytic optimum on both sides of the shift. Liveness is
+    # by construction: grace_steps (13) > the interval ceiling (12), so
+    # every cycle commits at least one new save before it can die and the
+    # resume point advances monotonically.
+    ap_preset = dict(preset, training_steps=AP_STEPS,
+                     checkpoint_frequency="auto")
+    ap_flags = (
+        "--ckpt-auto-floor", "1", "--ckpt-auto-ceiling", str(AP_CEILING),
+        "--ckpt-auto-window", "4",
+    )
+    ap_plan = {
+        "seed": seed,
+        "faults": [{
+            "type": "random_sigkill", "rate_per_step": AP_RATE,
+            "seed": seed * 1000 + 17, "grace_steps": AP_GRACE,
+            "start_step": 0, "end_step": AP_SHIFT,
+        }],
+    }
+    cycle("ap_golden", resume=False, expect_rc=(0,), exp="ap_golden",
+          fault_plan=None, extra_args=ap_flags, preset_over=ap_preset)
+    ap_kills = 0
+    ap_done = False
+    for attempt in range(AP_MAX_ATTEMPTS):
+        cycle(f"ap_run{attempt + 1}", resume=attempt > 0,
+              expect_rc=(0, -9, 137), exp="ap", extra_args=ap_flags,
+              fault_plan=ap_plan, preset_over=ap_preset)
+        rc = cycles[-1]["rc"]
+        if rc == 0:
+            ap_done = True
+            break
+        if rc in (-9, 137):
+            ap_kills += 1
+        else:
+            break  # the unexpected rc is already a cycle violation
+    if not ap_done:
+        violations.append(
+            f"autopilot drill: no clean finish within {AP_MAX_ATTEMPTS} "
+            f"resume attempts ({ap_kills} kills observed)"
+        )
 
     exp_dir = workdir / "chaos"
     golden_rows = _read_csv_rows(
@@ -783,6 +864,190 @@ def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
         },
     }
 
+    # autopilot drill verdicts: (a) the golden auto run degrades to the
+    # bounded prior with zero failures — every decision at the ceiling,
+    # one constant interval (never thrashes), periodic saves actually
+    # taken (never disables); (b) the faulted run's decision trail spans
+    # the kill/resume chain, the failure-history sidecar counts EXACTLY
+    # the observed kills, and the adapted interval lands within
+    # AP_CONVERGENCE_FACTOR of the clamp-bounded analytic Young–Daly
+    # optimum recomputed from each decision's own reported inputs on BOTH
+    # sides of the rate shift; (c) no checkpoints were quarantined (a
+    # hazard kill must never eat a committed save).
+    import math as _math
+
+    ap_dir = workdir / "ap"
+    ap_golden_events = read_events(
+        workdir / "ap_golden" / "ap_golden_telemetry.jsonl"
+    )
+    ap_g_policies = [
+        e for e in ap_golden_events if e["event"] == "ckpt_policy"
+    ]
+    ap_g_intervals = sorted({e.get("interval_steps") for e in ap_g_policies})
+    ap_g_saves = [
+        e["step"] for e in ap_golden_events
+        if e["event"] == "ckpt_saved" and not e.get("final")
+    ]
+    if not ap_g_policies:
+        violations.append("autopilot drill: golden auto run emitted no "
+                          "ckpt_policy decisions")
+    else:
+        if any(e.get("failures_observed") for e in ap_g_policies):
+            violations.append(
+                "autopilot drill: golden run reported nonzero failures"
+            )
+        if ap_g_intervals != [AP_CEILING]:
+            violations.append(
+                "autopilot drill: zero-failure run must hold the bounded "
+                f"prior (one constant interval {AP_CEILING}), got "
+                f"{ap_g_intervals}"
+            )
+        expected_saves = list(range(AP_CEILING, AP_STEPS, AP_CEILING))
+        if ap_g_saves != expected_saves:
+            violations.append(
+                "autopilot drill: zero-failure run must keep saving at "
+                f"the prior cadence {expected_saves}, got {ap_g_saves}"
+            )
+
+    ap_events = read_events(ap_dir / "ap_telemetry.jsonl")
+    ap_policies = [e for e in ap_events if e["event"] == "ckpt_policy"]
+    ap_fault_kills = sum(
+        1 for e in ap_events
+        if e["event"] == "fault_injected" and e.get("type") == "random_sigkill"
+    )
+    ap_segments = 0
+    ap_segments_with_policy = 0
+    seg_has = False
+    for e in ap_events:
+        if e["event"] == "run_start":
+            ap_segments += 1
+            if seg_has:
+                ap_segments_with_policy += 1
+            seg_has = False
+        elif e["event"] == "ckpt_policy":
+            seg_has = True
+    if seg_has:
+        ap_segments_with_policy += 1
+    if ap_kills < 2:
+        violations.append(
+            f"autopilot drill: expected >= 2 seeded kills before the rate "
+            f"shift, got {ap_kills}"
+        )
+    if ap_fault_kills != ap_kills:
+        violations.append(
+            f"autopilot drill: {ap_kills} kill exits but {ap_fault_kills} "
+            "random_sigkill fault_injected events — the announce-then-kill "
+            "trail is torn"
+        )
+    if ap_segments_with_policy < ap_kills + 1:
+        violations.append(
+            "autopilot drill: ckpt_policy decisions must appear in every "
+            f"run segment ({ap_segments} segments, only "
+            f"{ap_segments_with_policy} carried decisions)"
+        )
+    sidecar_path = ap_dir / "failure_history.json"
+    sidecar_interruptions = None
+    try:
+        sidecar = json.loads(sidecar_path.read_text())
+        sidecar_interruptions = [
+            r.get("kind") for r in sidecar.get("interruptions", [])
+        ]
+    except (OSError, ValueError):
+        violations.append(
+            "autopilot drill: failure-history sidecar missing/unreadable "
+            f"at {sidecar_path}"
+        )
+    if sidecar_interruptions is not None and (
+        len(sidecar_interruptions) != ap_kills
+        or any(k != "hard_kill" for k in sidecar_interruptions)
+    ):
+        violations.append(
+            f"autopilot drill: sidecar recorded {sidecar_interruptions}, "
+            f"expected exactly {ap_kills} hard_kill interruption(s) — the "
+            "resume-chain reconstruction lost or double-counted a death"
+        )
+
+    def _ap_convergence(decision, label):
+        cost = decision.get("cost_s")
+        mtti = decision.get("mtti_s")
+        iter_s = decision.get("step_iter_s")
+        chosen = decision.get("interval_steps")
+        if not all(
+            isinstance(v, (int, float)) and v > 0
+            for v in (cost, mtti, iter_s, chosen)
+        ):
+            violations.append(
+                f"autopilot drill: {label} decision carries unusable "
+                f"inputs: {decision}"
+            )
+            return None
+        analytic = _math.sqrt(2.0 * cost * mtti) / iter_s
+        clamped = min(max(analytic, 1.0), float(AP_CEILING))
+        ratio = chosen / clamped
+        if not (1.0 / AP_CONVERGENCE_FACTOR <= ratio <= AP_CONVERGENCE_FACTOR):
+            violations.append(
+                f"autopilot drill: {label} interval {chosen} is {ratio:.2f}x "
+                f"the bound-clamped analytic optimum {clamped:.2f} "
+                f"(raw {analytic:.2f}; cost {cost}s, MTTI {mtti}s, "
+                f"step {iter_s}s) — outside {AP_CONVERGENCE_FACTOR}x"
+            )
+        return {"chosen": chosen, "analytic": round(analytic, 3),
+                "clamped": round(clamped, 3), "ratio": round(ratio, 3)}
+
+    pre_shift = [e for e in ap_policies if e.get("step", 0) < AP_SHIFT
+                 and e.get("failures_observed", 0) > 0]
+    post_shift = [e for e in ap_policies if e.get("step", 0) >= AP_SHIFT]
+    ap_pre = ap_post = None
+    if not pre_shift:
+        violations.append(
+            "autopilot drill: no failure-informed ckpt_policy decision "
+            "before the rate shift"
+        )
+    else:
+        ap_pre = _ap_convergence(pre_shift[-1], "pre-shift")
+    if not post_shift:
+        violations.append(
+            "autopilot drill: no ckpt_policy decision after the rate shift"
+        )
+    else:
+        ap_post = _ap_convergence(post_shift[-1], "post-shift")
+    if pre_shift and post_shift:
+        # the hazard dropped to zero at the shift: the windowed MTTI can
+        # only grow from there, so the adapted interval must never come
+        # back DOWN after the last pre-shift decision
+        if post_shift[-1].get("interval_steps", 0) < pre_shift[-1].get(
+            "interval_steps", 0
+        ):
+            violations.append(
+                "autopilot drill: interval shrank after the failure rate "
+                f"dropped to zero ({pre_shift[-1].get('interval_steps')} "
+                f"-> {post_shift[-1].get('interval_steps')})"
+            )
+    if not (ap_dir / "DONE").exists():
+        violations.append("autopilot drill: no DONE marker after recovery")
+    ap_quarantined = [p.name for p in list_quarantined(ap_dir)]
+    if ap_quarantined:
+        violations.append(
+            "autopilot drill: a hazard kill must never eat a committed "
+            f"save, but {ap_quarantined} got quarantined"
+        )
+    ap_info = {
+        "kills": ap_kills,
+        "attempts": sum(1 for c in cycles if c["name"].startswith("ap_run")),
+        "decisions": len(ap_policies),
+        "segments": ap_segments,
+        "segments_with_decisions": ap_segments_with_policy,
+        "sidecar_interruptions": sidecar_interruptions,
+        "pre_shift": ap_pre,
+        "post_shift": ap_post,
+        "golden_intervals": ap_g_intervals,
+        "golden_saves": ap_g_saves,
+        "interval_trajectory": [
+            e.get("interval_steps") for e in ap_policies
+        ],
+        "quarantined": ap_quarantined,
+    }
+
     zs_info = {
         "rows": len(zs_rows),
         "continuity_ok": zs_continuity,
@@ -822,6 +1087,7 @@ def run_soak(preset_name="smoke", seed=0, workdir=None, json_out=None):
         "zerostall": zs_info,
         "zero1": z1_info,
         "bucket": bucket_info,
+        "autopilot": ap_info,
         "telemetry_rotated_shards": rotated,
         "telemetry_counts": {
             k: counts.get(k, 0)
@@ -892,6 +1158,14 @@ def main(argv=None):
           f"(tol {bki.get('rtol')}) | fp32 layout flip "
           f"{'bit-exact' if bkf.get('bitexact') else 'DIVERGED'} "
           f"({bkf.get('rows')} rows)")
+    ap = report.get("autopilot") or {}
+    pre, post = ap.get("pre_shift") or {}, ap.get("post_shift") or {}
+    print(f"  autopilot: {ap.get('kills')} seeded kills over "
+          f"{ap.get('attempts')} attempts | {ap.get('decisions')} decisions "
+          f"across {ap.get('segments_with_decisions')} segments | interval "
+          f"pre-shift {pre.get('chosen')} vs optimum {pre.get('clamped')} | "
+          f"post-shift {post.get('chosen')} vs {post.get('clamped')} | "
+          f"golden prior {ap.get('golden_intervals')}")
     if report["violations"]:
         for v in report["violations"]:
             print(f"  VIOLATION: {v}")

@@ -11,6 +11,8 @@ Plan format (JSON — inline in ``$PYRECOVER_FAULT_PLAN`` or a file path)::
     {"seed": 0, "faults": [
         {"type": "sigterm_at_step", "step": 4},
         {"type": "kill9_during_save", "save_index": 1, "after_bytes": 0},
+        {"type": "random_sigkill", "rate_per_step": 0.3, "seed": 7,
+         "grace_steps": 13, "start_step": 0, "end_step": 32},
         {"type": "corrupt_ckpt_bytes", "save_index": 2,
          "offset": null, "count": 64},
         {"type": "transient_io_error", "op": "write", "fail_count": 2},
@@ -42,6 +44,7 @@ pick their plan up with zero wiring), then rebinds.
 import errno
 import json
 import os
+import random
 import signal
 import threading
 import time
@@ -152,6 +155,79 @@ class _Kill9DuringSave(_Fault):
     def execute(self, engine, site, ctx):
         self._announce(site, save_index=self.save_index,
                        written=ctx.get("written", 0))
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+class _RandomSigkill(_Fault):
+    """Seeded hazard-rate hard kill: each eligible train step dies with
+    probability ``rate_per_step`` — interruptions as a *rate*, not one
+    scheduled deadline. This is the fault that drives the goodput
+    autopilot's convergence drill (the adapted checkpoint interval must
+    track the Young–Daly optimum for the seeded MTTI).
+
+    Determinism: the RNG is seeded with ``(seed, first eligible step)``,
+    so a given resume point replays the identical kill schedule — the
+    whole chaos drill reproduces from its seed. ``start_step`` /
+    ``end_step`` bound the hazard window in GLOBAL steps (two specs with
+    disjoint windows encode a mid-run rate shift); ``grace_steps`` is a
+    hazard-free count of eligible steps after each process start.
+    Liveness depends on it: a kill landing before the resumed process
+    reaches its first new checkpoint would replay the identical schedule
+    forever, so set ``grace_steps`` strictly above the autopilot's
+    interval ceiling (every cycle then commits at least one save before
+    it can die, and the resume point advances monotonically)."""
+
+    sites = ("train_step",)
+    type_name = "random_sigkill"
+
+    def __init__(self, spec):
+        super().__init__(spec)
+        self.rate = float(spec["rate_per_step"])
+        if not 0.0 < self.rate <= 1.0:
+            raise FaultPlanError(
+                f"random_sigkill: rate_per_step must be in (0, 1], got "
+                f"{self.rate}"
+            )
+        self.seed = int(spec.get("seed", 0))
+        self.grace = int(spec.get("grace_steps", 0))
+        self.start_step = int(spec.get("start_step", 0))
+        end = spec.get("end_step")
+        self.end_step = None if end is None else int(end)
+        if self.end_step is not None and self.end_step <= self.start_step:
+            raise FaultPlanError(
+                f"random_sigkill: end_step {self.end_step} must be > "
+                f"start_step {self.start_step}"
+            )
+        self._rng = None
+        self._eligible = 0
+        self._fire_step = None
+
+    def should_fire(self, engine, site, ctx):
+        step = ctx.get("step")
+        if not isinstance(step, int):
+            return False
+        if step < self.start_step or (
+            self.end_step is not None and step >= self.end_step
+        ):
+            return False
+        if self._rng is None:
+            # keyed on the first eligible step: the schedule is a pure
+            # function of (seed, resume point); a string seed hashes via
+            # sha512 — stable across processes and platforms
+            self._rng = random.Random(f"{self.seed}:{step}")
+        self._eligible += 1
+        if self._eligible <= self.grace:
+            return False
+        if self._rng.random() < self.rate:
+            self._fire_step = step
+            return True
+        return False
+
+    def execute(self, engine, site, ctx):
+        # announce BEFORE the kill: the per-event-flushed telemetry JSONL
+        # is the only record this process gets to leave
+        self._announce(site, step=self._fire_step, rate=self.rate,
+                       grace_steps=self.grace)
         os.kill(os.getpid(), signal.SIGKILL)
 
 
@@ -284,7 +360,7 @@ class _MetadataFlap(_Fault):
 _FAULT_TYPES = {
     cls.type_name: cls
     for cls in (
-        _SigtermAtStep, _Kill9DuringSave, _CorruptCkptBytes,
+        _SigtermAtStep, _Kill9DuringSave, _RandomSigkill, _CorruptCkptBytes,
         _TransientIOError, _LoaderStall, _MetadataFlap,
     )
 }

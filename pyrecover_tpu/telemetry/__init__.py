@@ -109,6 +109,21 @@ Core event names across the stack (fields beyond the envelope):
                       target_topology (the serving engine restored the
                       .params subtree read-only from a checkpoint,
                       preflighted and placed for the serving mesh)
+    ckpt_policy       step, source, engine, interval_steps,
+                      prev_interval_steps, optimum_steps, optimum_s,
+                      cost_s, mtti_s, step_iter_s, failures_observed,
+                      failures_window, reason, floor, ceiling,
+                      static_interval, engine_recommendation (one goodput-
+                      autopilot decision under --checkpoint-frequency
+                      auto: the live failure model's inputs, the analytic
+                      Young-Daly optimum, and the chosen bounded interval;
+                      the trail survives kill/resume via the
+                      failure_history.json sidecar and summarize_telemetry
+                      renders it plus the goodput-vs-static
+                      counterfactual)
+    ckpt_policy_sidecar_error  error (the failure-history sidecar could
+                      not be persisted — the policy degrades to stale
+                      estimates on the next resume, the run continues)
     preempt_check     step, time_left_s, threshold_s
     preempt_notice / preempt_stop / preempt_estimate
     preempt_signal_escalation  signal, count, step (2nd signal mid-save)

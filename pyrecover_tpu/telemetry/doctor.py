@@ -104,6 +104,7 @@ def gather(target):
         "bundles": [],
         "fatal_stacks": False,
         "marker": None,
+        "interrupt_history": None,
     }
     if target.is_file():  # a bare telemetry JSONL
         ev["telemetry_path"] = str(target)
@@ -136,6 +137,19 @@ def gather(target):
         pass
     if root.is_dir():
         ev["marker"] = _read_marker(root)
+        # goodput-autopilot failure-history sidecar: the run's own record
+        # of every interruption over the resume chain (kinds + steps) —
+        # tolerant read, same policy as the markers
+        sidecar = root / "failure_history.json"
+        if sidecar.is_file():
+            try:
+                doc = json.loads(sidecar.read_text())
+                if isinstance(doc, dict) and isinstance(
+                    doc.get("interruptions"), list
+                ):
+                    ev["interrupt_history"] = doc
+            except (OSError, ValueError):
+                pass
     return ev
 
 
@@ -265,6 +279,35 @@ def analyze(evidence, *, recompile_storm_threshold=DEFAULT_RECOMPILE_STORM):
     earlier = len(events) - len(seg)
     if earlier:
         finding("earlier_segments", f"{earlier} event(s) from prior attempts")
+    # failure-history sidecar (goodput autopilot): the resume chain's own
+    # interruption ledger — how often this experiment actually dies, by kind
+    interrupt_history = None
+    hist_doc = evidence.get("interrupt_history")
+    if hist_doc is not None:
+        records = [
+            r for r in hist_doc.get("interruptions", [])
+            if isinstance(r, dict) and r.get("kind")
+        ]
+        by_kind = {}
+        for r in records:
+            by_kind[r["kind"]] = by_kind.get(r["kind"], 0) + 1
+        interrupt_history = {
+            "count": len(records),
+            "by_kind": by_kind,
+            "last_ts": max(
+                (r.get("ts") for r in records
+                 if isinstance(r.get("ts"), (int, float))), default=None,
+            ),
+            "interval_steps": (hist_doc.get("estimates") or {}).get(
+                "interval_steps"
+            ),
+        }
+        if records:
+            finding(
+                "interrupt_history",
+                f"{len(records)} interruption(s) over the resume chain: "
+                + ", ".join(f"{k}×{v}" for k, v in sorted(by_kind.items())),
+            )
 
     # -- classification (most-specific first) --------------------------------
     bundle_reason = (
@@ -388,6 +431,7 @@ def analyze(evidence, *, recompile_storm_threshold=DEFAULT_RECOMPILE_STORM):
             "hangs": n_hangs,
             "collective_hangs": len(coll_spans) + n_wait_timeouts,
             "topology_rejections": n_topology,
+            "interrupt_history": interrupt_history,
             "last_status": (summary or {}).get("status"),
         },
     }

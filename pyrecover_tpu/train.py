@@ -984,6 +984,32 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
         )
     else:
         prior_step = None  # nothing to replay (fresh start / no progress record)
+
+    # ---- goodput autopilot (--checkpoint-frequency auto) -------------------
+    # telemetry-driven cadence: bootstrap folds every prior attempt's death
+    # (hard kills, crashes, preemptions, hangs) from the telemetry stream
+    # into the failure-history sidecar, then takes the initial Young-Daly
+    # decision from the persisted estimates. The interval gates a
+    # COLLECTIVE save, so decisions are host-0-computed and broadcast
+    # inside decide() — every host agrees on every save step.
+    autopilot = None
+    ap_next_save = None
+    if config.checkpoint_auto:
+        from pyrecover_tpu.resilience.autopilot import CheckpointAutopilot
+
+        autopilot = CheckpointAutopilot(
+            exp_dir, engine=engine,
+            static_interval=config.checkpoint_frequency,
+            floor=config.ckpt_auto_floor,
+            ceiling=config.ckpt_auto_ceiling,
+            mtti_prior_s=config.ckpt_auto_mtti_prior_s,
+            window=config.ckpt_auto_window,
+            default_cost_s=config.default_ckpt_time,
+            default_iter_s=config.default_iter_time,
+        )
+        ap_next_save = start_step + autopilot.bootstrap(
+            telemetry_path, step=start_step
+        )
     loader = DataLoader(
         dataset, sampler, pad_token_id=pad_token_id, mesh=mesh,
         prefetch=2, num_workers=4,
@@ -1264,6 +1290,10 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
                     # where it spikes)
                     dt, n = close_interval(time.monotonic())
                     watcher.observe_iter(dt / n)
+                    if autopilot is not None:
+                        # same interval-average feed; the autopilot's
+                        # median estimator shrugs off the compile outlier
+                        autopilot.observe_iter(dt / n, n=n, step=step)
                     # the deliberate sync is itself a trace slice, and the
                     # interval-average iter time feeds the step-time
                     # histogram (weight n: it stands in for n steps)
@@ -1318,16 +1348,27 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
                     sync_t0 = time.monotonic()
                     meter.reset()
 
-                # periodic checkpoint (reference train.py:310-331)
-                if (
-                    config.checkpoint_frequency > 0
-                    and step % config.checkpoint_frequency == 0
-                    and step < config.training_steps
-                ):
+                # periodic checkpoint (reference train.py:310-331). With
+                # the autopilot, "periodic" is the adaptive interval: the
+                # next save step is re-decided after every save from the
+                # freshly observed cost + the live failure model.
+                if autopilot is not None:
+                    ckpt_due = step >= ap_next_save
+                else:
+                    ckpt_due = (
+                        config.checkpoint_frequency > 0
+                        and step % config.checkpoint_frequency == 0
+                    )
+                if ckpt_due and step < config.training_steps:
                     close_interval(time.monotonic())
                     secs = save_ckpt(step)
                     totals.ckpt_save_s += secs
                     watcher.observe_ckpt(secs)
+                    if autopilot is not None:
+                        autopilot.observe_save(secs)
+                        ap_next_save = step + autopilot.decide(
+                            step, source="post_save"
+                        )
                     # don't attribute checkpoint time to iteration time
                     sync_t0 = time.monotonic()
 
@@ -1344,8 +1385,11 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
         close_interval(time.monotonic())  # tail interval since the last sync
         totals.train_s = time.monotonic() - train_t0
 
-        # final checkpoint at completion (`latest` is always the end state)
-        if not stopped_early and config.checkpoint_frequency > 0:
+        # final checkpoint at completion (`latest` is always the end state);
+        # the autopilot never disables saves, whatever the static knob says
+        if not stopped_early and (
+            config.checkpoint_frequency > 0 or autopilot is not None
+        ):
             secs = save_ckpt(step, final=True)
             totals.ckpt_save_s += secs
     finally:

@@ -416,4 +416,21 @@ def test_chaos_smoke_soak_bitexact(tmp_path):
     assert bk["fp32_layout_flip"]["bitexact"]
     assert bk["fp32_layout_flip"]["continuity_ok"]
     assert bk["fp32_layout_flip"]["quarantined"] == []
+    # ISSUE 14 autopilot drill: seeded hazard-rate kills with a mid-run
+    # rate shift under --checkpoint-frequency auto — the adapted interval
+    # lands within 2x of the analytic Young-Daly optimum on both sides of
+    # the shift, the ckpt_policy trail survives every kill/resume via the
+    # failure-history sidecar (which counts exactly the observed kills),
+    # and the zero-failure golden run holds the bounded prior
+    ap = report["autopilot"]
+    assert ap["kills"] >= 2
+    assert ap["sidecar_interruptions"] == ["hard_kill"] * ap["kills"]
+    assert ap["segments_with_decisions"] >= ap["kills"] + 1
+    for side in ("pre_shift", "post_shift"):
+        assert ap[side] is not None
+        assert 0.5 <= ap[side]["ratio"] <= 2.0
+    assert ap["quarantined"] == []
+    from pyrecover_tpu.resilience.chaos import AP_CEILING
+
+    assert ap["golden_intervals"] == [AP_CEILING]
     assert (tmp_path / "report.json").exists()

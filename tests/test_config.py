@@ -83,6 +83,70 @@ def test_checkpoint_frequency_disable():
     assert cfg.checkpoint_frequency == -1
 
 
+def test_checkpoint_frequency_normalizes_any_disable_value():
+    """ISSUE 14 satellite: the docs promise "-1 disables" while the train
+    gate was `> 0`, so 0 and other negatives silently disabled too. Every
+    value < 1 now canonicalizes to -1, loudly."""
+    import logging
+
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    # the project logger sets propagate=False, so capture directly on it
+    logger = logging.getLogger("pyrecover_tpu")
+    handler = _Capture(level=logging.WARNING)
+    logger.addHandler(handler)
+    prior_level = logger.level
+    logger.setLevel(logging.WARNING)
+    try:
+        assert get_args(["--checkpoint-frequency", "0"]
+                        ).checkpoint_frequency == -1
+        assert get_args(["--checkpoint-frequency", "-7"]
+                        ).checkpoint_frequency == -1
+        hits = [m for m in records if "disables periodic checkpoints" in m]
+        assert len(hits) == 2
+        # the canonical -1 is already the documented spelling: no noise
+        records.clear()
+        assert get_args(["--checkpoint-frequency", "-1"]
+                        ).checkpoint_frequency == -1
+        assert not [m for m in records if "disables periodic" in m]
+    finally:
+        logger.removeHandler(handler)
+        logger.setLevel(prior_level)
+
+
+def test_checkpoint_frequency_auto_and_knobs():
+    cfg = get_args(["--checkpoint-frequency", "auto"])
+    assert cfg.checkpoint_auto
+    # the numeric default survives as the static-counterfactual baseline
+    assert cfg.checkpoint_frequency == 10
+    assert not get_args([]).checkpoint_auto
+    cfg2 = get_args(["--checkpoint-frequency", "auto",
+                     "--ckpt-auto-floor", "2", "--ckpt-auto-ceiling", "64",
+                     "--ckpt-auto-mtti-prior", "120",
+                     "--ckpt-auto-window", "6"])
+    assert (cfg2.ckpt_auto_floor, cfg2.ckpt_auto_ceiling) == (2, 64)
+    assert cfg2.ckpt_auto_mtti_prior_s == 120.0
+    assert cfg2.ckpt_auto_window == 6
+    import pytest
+
+    with pytest.raises(SystemExit):  # argparse rejects non-int non-auto
+        get_args(["--checkpoint-frequency", "sometimes"])
+    from pyrecover_tpu.config import TrainConfig
+
+    with pytest.raises(ValueError):
+        TrainConfig(ckpt_auto_floor=0)
+    with pytest.raises(ValueError):
+        TrainConfig(ckpt_auto_floor=8, ckpt_auto_ceiling=4)
+    with pytest.raises(ValueError):
+        TrainConfig(ckpt_auto_mtti_prior_s=0.0)
+    with pytest.raises(ValueError):
+        TrainConfig(ckpt_auto_window=0)
+
+
 def test_attention_impl_auto_selection():
     """auto → ring under --sp > 1, flash under --use_flash_attention,
     sdpa otherwise; explicit choice always wins."""

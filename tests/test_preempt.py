@@ -11,6 +11,7 @@ import pytest
 from pyrecover_tpu.preempt import (
     DONE_MARKER,
     REQUEUE_MARKER,
+    DecayingMaxEstimator,
     PreemptionWatcher,
     get_job_end_time,
     write_requeue_marker,
@@ -60,6 +61,39 @@ def test_adaptive_thresholds_learn_maxima():
     assert w.max_iter_time == 3.5
     assert w.max_ckpt_time == 25.0
     assert w.safety_buffer == pytest.approx(5 * 3.5 + 2 * 25.0)
+
+
+def test_safety_buffer_recovers_after_an_outlier():
+    """ISSUE 14 satellite: the old max-only estimator let ONE compile-step
+    or straggler outlier inflate the safety buffer for the rest of the
+    job. The decaying high-quantile estimate relaxes back toward the live
+    regime once the outlier leaves the short window."""
+    w = PreemptionWatcher(enabled=True, default_iter_time=1.0,
+                          default_ckpt_time=10.0, job_end_time=None)
+    w.observe_iter(60.0)  # the compile-step outlier
+    assert w.max_iter_time == 60.0  # immediately covered (window floor)
+    for _ in range(40):
+        w.observe_iter(1.0)
+    # the outlier decayed out; the estimate sits near the live regime
+    assert w.max_iter_time < 5.0
+    assert w.max_iter_time >= 1.0  # never below anything recently seen
+    assert w.safety_buffer < 5 * 5.0 + 2 * 10.0
+
+
+def test_decaying_estimator_window_floor_and_default():
+    est = DecayingMaxEstimator(2.0, decay=0.5, window=3)
+    assert est.value == 2.0  # the prior before any observation
+    est.observe(10.0)
+    est.observe(1.0)
+    # 10.0 is still inside the 3-observation window: full coverage
+    assert est.value == 10.0
+    est.observe(1.0)
+    est.observe(1.0)  # 10.0 left the window; decayed peak 10*0.5^3=1.25
+    assert est.value == pytest.approx(1.25)
+    # a genuine sustained slowdown holds the estimate up indefinitely
+    for _ in range(20):
+        est.observe(7.0)
+    assert est.value == 7.0
 
 
 def test_notice_file_triggers_stop(tmp_path):
