@@ -10,6 +10,11 @@ renders:
     lost to checkpoint save/load, restart re-warmup, and replayed steps;
   * step-time breakdown (data-wait vs dispatch vs synced iteration time);
   * checkpoint lifecycle totals per engine (blocking vs background);
+  * the goodput-autopilot decision trail (``ckpt_policy`` events: the
+    live failure model, the Young-Daly optimum, the chosen interval) and
+    the static-policy counterfactual — what the configured static
+    interval would have lost on the SAME event stream (interval-spaced
+    saves at the measured mean blocking cost + per-death replay);
   * preemption / maintenance / data-stall event digests.
 
 ``--json OUT`` additionally writes a BENCH-compatible blob
@@ -316,6 +321,108 @@ def aggregate(events):
         }
     agg["serving"] = serving
 
+    # checkpoint-policy (autopilot) rollup + the static-policy
+    # counterfactual: replay the SAME event stream against the configured
+    # static interval — saves it would have paid (interval-spaced at the
+    # measured mean blocking cost) plus the steps each observed death
+    # would have replayed from its last interval-aligned save — so the
+    # goodput report can state what the static policy would have lost.
+    policies = by.get("ckpt_policy", [])
+    saved_events = by.get("ckpt_saved", [])
+    save_costs = [
+        float(e["blocking_s"]) for e in saved_events
+        if isinstance(e.get("blocking_s"), (int, float))
+    ]
+    # one death per run segment that never reached a run_summary: the
+    # last step the stream saw is where the interruption landed
+    death_steps = []
+    max_step = 0
+    for seg in segments(events):
+        seg_steps = [
+            int(e["step"]) for e in seg["events"] + [seg["start"]]
+            if e.get("event") in ("train_sync", "step_time", "ckpt_saved")
+            and isinstance(e.get("step"), int)
+        ]
+        if seg_steps:
+            max_step = max(max_step, max(seg_steps))
+        if seg["summary"] is None and seg_steps:
+            death_steps.append(max(seg_steps))
+    static_interval = next(
+        (
+            int(e["static_interval"]) for e in reversed(policies)
+            if isinstance(e.get("static_interval"), int)
+            and e["static_interval"] > 0
+        ),
+        None,
+    )
+    if static_interval is None and len(saved_events) >= 2:
+        # no autopilot trail: infer the static cadence from the modal gap
+        # between the run's own saves
+        gaps = [
+            b["step"] - a["step"]
+            for a, b in zip(saved_events, saved_events[1:])
+            if isinstance(a.get("step"), int)
+            and isinstance(b.get("step"), int)
+            and b["step"] > a["step"]
+        ]
+        if gaps:
+            static_interval = max(set(gaps), key=gaps.count)
+    autopilot = {}
+    if policies:
+        last = policies[-1]
+        autopilot["decisions"] = len(policies)
+        autopilot["segments_with_decisions"] = sum(
+            1 for s in segments(events)
+            if any(x.get("event") == "ckpt_policy" for x in s["events"])
+        )
+        autopilot["last"] = {
+            k: last.get(k)
+            for k in ("step", "interval_steps", "optimum_steps", "cost_s",
+                      "mtti_s", "step_iter_s", "failures_observed",
+                      "reason", "engine", "engine_recommendation")
+        }
+        autopilot["interval_trajectory"] = [
+            e.get("interval_steps") for e in policies
+        ]
+        autopilot["engine_recommendations"] = sorted({
+            e["engine_recommendation"] for e in policies
+            if e.get("engine_recommendation")
+        })
+    step_time = agg["steps"]["iter_s_mean"] or 0.0
+    if static_interval and save_costs and step_time > 0 and max_step > 0:
+        mean_cost = _mean(save_costs)
+        k = static_interval
+        static_saves = max_step // k
+        static_save_s = static_saves * mean_cost
+        static_replay_steps = sum(d - (d // k) * k for d in death_steps)
+        static_replay_s = static_replay_steps * step_time
+        t = agg["totals"]
+        # the measured side is priced the SAME way (replayed steps x mean
+        # step time + blocking save seconds) so the comparison is model
+        # vs model on one stream — raw replayed_s wall time also carries
+        # each restart's compile, which the static policy would pay too
+        measured_replay_steps = int(t.get("replayed_steps", 0))
+        measured_lost_s = (
+            float(t.get("ckpt_save_s", 0.0))
+            + measured_replay_steps * step_time
+        )
+        autopilot["counterfactual"] = {
+            "static_interval": k,
+            "static_saves": static_saves,
+            "static_save_s": round(static_save_s, 4),
+            "static_replay_steps": static_replay_steps,
+            "static_replay_s": round(static_replay_s, 4),
+            "static_lost_s": round(static_save_s + static_replay_s, 4),
+            "measured_lost_s": round(measured_lost_s, 4),
+            "delta_s": round(
+                static_save_s + static_replay_s - measured_lost_s, 4
+            ),
+            "deaths": len(death_steps),
+            "measured_replay_steps": measured_replay_steps,
+            "mean_save_cost_s": round(mean_cost, 6),
+        }
+    agg["autopilot"] = autopilot
+
     agg["warnings"] = [
         f"MFU denominator unknown for device kind {e.get('device_kind')!r}"
         for e in by.get("mfu_peak_unknown", [])
@@ -356,6 +463,13 @@ def render(agg, out=None):
         w(f"  eval               {_fmt_s(t.get('eval_s', 0.0))}\n")
         if agg["goodput_pct"] is not None:
             w(f"  GOODPUT            {agg['goodput_pct']:.1f}%\n")
+        cf = (agg.get("autopilot") or {}).get("counterfactual")
+        if cf:
+            w(f"  static policy      every {cf['static_interval']} steps "
+              f"would have lost {_fmt_s(cf['static_lost_s'])} "
+              f"(saves {_fmt_s(cf['static_save_s'])} + replay "
+              f"{_fmt_s(cf['static_replay_s'])} over {cf['deaths']} "
+              f"death(s)) vs {_fmt_s(cf['measured_lost_s'])} measured\n")
     st = agg["steps"]
     if st["recorded"]:
         w("\n-- step-time breakdown -----------------------------------------\n")
@@ -474,6 +588,36 @@ def render(agg, out=None):
               f"{ra.get('device_kind') or '<unknown>'} (budget {budget}, "
               f"suggested per-chip batch "
               f"{ra.get('suggested_batch_per_chip')})\n")
+    ap = agg.get("autopilot") or {}
+    if ap.get("decisions"):
+        w("\n-- checkpoint policy (autopilot) --------------------------------\n")
+        last = ap["last"]
+        w(f"  decisions          {ap['decisions']} across "
+          f"{ap['segments_with_decisions']} run segment(s)\n")
+        w(f"  last decision      every {last['interval_steps']} steps @ "
+          f"step {last['step']} ({last['reason']}; engine "
+          f"{last['engine']})\n")
+        if last.get("mtti_s") is not None:
+            w(f"  failure model      {last['failures_observed']} "
+              f"interruption(s), MTTI ~{last['mtti_s']:.1f}s, save cost "
+              f"~{last['cost_s']:.3f}s, step ~"
+              f"{(last['step_iter_s'] or 0) * 1e3:.1f}ms\n")
+        if last.get("optimum_steps") is not None:
+            w(f"  Young-Daly optimum {last['optimum_steps']:.1f} steps "
+              f"(sqrt(2 * cost * MTTI))\n")
+        traj = ap.get("interval_trajectory") or []
+        if len(traj) > 1:
+            w(f"  interval trail     {' -> '.join(str(i) for i in traj)}\n")
+        for eng in ap.get("engine_recommendations") or []:
+            w(f"  RECOMMENDATION     switch --checkpoint-engine to {eng} "
+              f"(measured save cost indefensible for the current "
+              f"engine)\n")
+        cf = ap.get("counterfactual")
+        if cf:
+            verb = "saved" if cf["delta_s"] >= 0 else "COST"
+            w(f"  vs static          {verb} {_fmt_s(abs(cf['delta_s']))} "
+              f"against the every-{cf['static_interval']}-steps static "
+              f"policy on this event stream\n")
     sv = agg.get("serving") or {}
     if sv:
         w("\n-- serving (request latency) -----------------------------------\n")
@@ -541,6 +685,7 @@ def main(argv=None):
                 "ckpt_backpressure": agg["ckpt_backpressure"],
                 "emergency": agg["emergency"],
                 "wire": agg["wire"],
+                "autopilot": agg["autopilot"],
                 "serving": agg["serving"],
                 "data_stalls": agg["data_stalls"],
                 "preempt": agg["preempt"],
