@@ -277,4 +277,64 @@ else
   rc=1
 fi
 
+# hot-swap smoke + chaos drill: the train→serve distribution plane's gate
+# (pyrecover_tpu/serving/hotswap). One process trains (zerostall saves of
+# a partially-perturbed state) while the load generator drives the engine
+# open-loop and the registry watcher swaps weights live; then a serving
+# replica subprocess is SIGKILLed mid-fetch. Fails unless (a) >=1 swap
+# completed with token-level equality vs a COLD restore of the final
+# manifest, (b) the incremental fetch moved strictly less than the full
+# params bytes (reused bytes reported), (c) p99 latency across the swap
+# window stays within the gate vs the same workload on a no-swap engine,
+# and (d) the chaos drill proves zero torn state: restart serves the old
+# manifest digest-verified, the pin lease shields in-fetch chunks from
+# GC, zero quarantines, zero leaked chunks after lease expiry. The
+# smoke's telemetry shard is then fed to summarize_telemetry, which must
+# render the hot-swap section (count, bytes fetched vs reused, p99
+# across swaps).
+HOTSWAP_WORK="${HOTSWAP_WORK:-/tmp/pyrecover_hotswap_smoke}"
+rm -rf "$HOTSWAP_WORK"
+if HS_OUT=$(JAX_PLATFORMS=cpu python tools/bench_decode.py \
+    --hotswap-smoke "$HOTSWAP_WORK" 2>&1); then
+  HS_LINE=$(echo "$HS_OUT" | grep '"metric": "hotswap_smoke"' | tail -1) \
+    || HS_LINE=""
+  HS_LINE="$HS_LINE" python - <<'PYEOF' || rc=1
+import json, os
+rep = json.loads(os.environ["HS_LINE"])
+assert rep["ok"] and rep["metric"] == "hotswap_smoke", rep
+assert rep["swaps"] >= 1 and rep["rejected"] == 0, rep
+assert rep["token_equal"], "post-swap serving diverged from cold restore"
+assert rep["reused_bytes"] > 0, "incremental fetch reused nothing"
+assert rep["fetched_bytes"] < rep["swaps"] * rep["params_bytes"], \
+    "fetch moved the whole params set — nothing incremental"
+assert rep["p99_e2e_s"] <= rep["p99_gate_s"], \
+    f"p99 across the swap window broke the gate: {rep['p99_e2e_s']}"
+ch = rep["chaos"]
+assert ch["kill_rc"] == -9 and ch["old_manifest_probe_equal"], ch
+assert not ch["quarantined"] and ch["chunks_leaked"] == 0, ch
+print(f"hotswap smoke: OK — {rep['swaps']} live swaps token-equal to "
+      f"cold restore ({rep['fetched_bytes']} B fetched / "
+      f"{rep['reused_bytes']} B reused), p99 {rep['p99_e2e_s']}s <= gate "
+      f"{rep['p99_gate_s']}s; chaos: kill mid-swap -> old manifest "
+      f"served, 0 quarantined, 0 leaked")
+PYEOF
+else
+  echo "$HS_OUT"
+  rc=1
+fi
+if HS_SUM=$(JAX_PLATFORMS=cpu python tools/summarize_telemetry.py \
+    "$HOTSWAP_WORK/hotswap_telemetry.jsonl" 2>&1); then
+  if echo "$HS_SUM" | grep -q "hot-swap" \
+      && echo "$HS_SUM" | grep -q "bytes fetched" \
+      && echo "$HS_SUM" | grep -q "p99 across swaps"; then
+    echo "$HS_SUM" | grep -A 4 "hot-swap (train" | head -5
+  else
+    echo "summarize_telemetry: hot-swap section missing"
+    rc=1
+  fi
+else
+  echo "$HS_SUM"
+  rc=1
+fi
+
 exit $rc

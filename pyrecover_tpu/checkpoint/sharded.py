@@ -29,6 +29,23 @@ from pyrecover_tpu.resilience import faults
 from pyrecover_tpu.utils.logging import log_host0
 
 
+def _params_leaf_digests(state):  # jaxlint: host-only
+    """``{manifest path: BLAKE2b-128 hex}`` over the fully-addressable
+    ``.params`` leaves — the serving restore's tamper gate (non-
+    addressable pod shards are skipped: no gathers in the save path)."""
+    from pyrecover_tpu.checkpoint.zerostall.chunkstore import leaf_digest
+
+    digests = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        key = jax.tree_util.keystr(path)
+        if not key.startswith(".params"):
+            continue
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            continue
+        digests[key] = leaf_digest(leaf)
+    return digests
+
+
 class ShardedCheckpointer:
     """Long-lived checkpointer; owns the async machinery. Use as a context
     manager or call close()."""
@@ -64,6 +81,13 @@ class ShardedCheckpointer:
             # saved topology: the elastic-resume gate (checkpoint/elastic.py)
             # diffs this against the live mesh before any tensor read
             "topology": state_topology(state),
+            # per-params-leaf content digests: Orbax's raw (target-free)
+            # read verifies nothing, so the serving restore needs its own
+            # tamper gate. Fully-addressable leaves only — digesting a
+            # pod-sharded leaf would force the allgather this engine
+            # exists to avoid; a leaf without a digest is simply not
+            # verifiable on that path (single-process covers them all).
+            "leaf_digests": _params_leaf_digests(state),
         }
         if extra_meta:
             meta.update(extra_meta)

@@ -73,6 +73,15 @@ def split_chunks(view, chunk_bytes):  # jaxlint: host-only
         yield view
 
 
+def leaf_digest(arr):  # jaxlint: host-only
+    """Whole-leaf BLAKE2b-128 content digest over a host array's byte
+    stream. The sharded engine records these per params leaf so the
+    serving restore can reject a tampered tensorstore file (Orbax's raw
+    read has no content verification of its own)."""
+    view = memoryview(np.ascontiguousarray(arr).view(np.uint8)).cast("B")
+    return chunk_digest(view)
+
+
 def leaf_chunk_digests(arr, chunk_bytes):  # jaxlint: host-only
     """Chunk digests of a host array's byte stream — the same addresses a
     save would produce; the emergency tier's strict freshness check and
@@ -256,10 +265,13 @@ def read_manifest(path):
 
 def _iter_manifests(exp_dir):
     """Every manifest whose chunks must be retained: live checkpoints in
-    the experiment dir AND quarantined ones under ``.corrupt/`` — a
+    the experiment dir, quarantined ones under ``.corrupt/`` — a
     quarantined manifest is forensic evidence and must stay restorable
-    until someone deletes it deliberately."""
+    until someone deletes it deliberately — and unexpired PIN leases
+    under ``pins/`` (each a copy of a manifest some reader is mid-fetch
+    on; the hot-swap fetcher's GC-race shield, see ``pins.py``)."""
     from pyrecover_tpu.checkpoint.registry import ZEROSTALL_SUFFIX
+    from pyrecover_tpu.checkpoint.zerostall import pins
     from pyrecover_tpu.resilience.quarantine import quarantine_dir
 
     exp_dir = Path(exp_dir)
@@ -273,6 +285,7 @@ def _iter_manifests(exp_dir):
             # collision-suffixed names (ckpt_3.zs.json.1) count too
             if p.is_file() and ZEROSTALL_SUFFIX in p.name:
                 yield p
+    yield from pins.live_pins(exp_dir)
 
 
 def referenced_digests(exp_dir):
@@ -292,12 +305,16 @@ def collect_garbage(exp_dir):  # jaxlint: host-only
     """Refcounted chunk GC: remove every chunk file no live manifest
     references. Safe against torn saves (orphan chunks from a killed
     writer are exactly what this collects) and NEVER collects a chunk a
-    live or quarantined manifest still needs. Returns
-    ``(removed_count, removed_bytes)``."""
+    live manifest, a quarantined manifest, or an unexpired pin lease
+    (a reader mid-fetch — ``pins.py``) still needs. Stale leases are
+    expired first, so a crashed reader delays reclamation by at most one
+    TTL. Returns ``(removed_count, removed_bytes)``."""
     from pyrecover_tpu.checkpoint.registry import ZEROSTALL_SUFFIX
+    from pyrecover_tpu.checkpoint.zerostall import pins
 
     t0 = time.monotonic()
     exp_dir = Path(exp_dir)
+    pins.expire_stale_pins(exp_dir)
     root = chunks_root(exp_dir)
     # manifest tmp files orphaned by a kill between mkstemp and the
     # rename (the ckpt_manifest_commit seam's litter): safe to sweep —

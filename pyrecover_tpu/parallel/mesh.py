@@ -210,6 +210,10 @@ def _note_dropped_axis(axis, axis_names):
     every trace."""
     if axis in _dropped_axes_warned:
         return
+    # concur: disable-next=unguarded-shared-state -- benign race: an
+    # idempotent warn-once cache (set.add of the same key); two roots
+    # racing (train main vs the hot-swap watcher's spec filtering) at
+    # worst emit the once-per-axis warning twice
     _dropped_axes_warned.add(axis)
     from pyrecover_tpu import telemetry
     from pyrecover_tpu.utils.logging import log_host0

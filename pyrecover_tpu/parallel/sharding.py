@@ -85,6 +85,10 @@ def spec_for_manifest_path(path_str, ndim):
     if _KEYSTR_TOKEN is None:
         import re
 
+        # concur: disable-next=unguarded-shared-state -- benign race: a
+        # lazy one-time compile of a constant pattern; two roots (resume
+        # main vs the hot-swap watcher placing params) racing the None
+        # check both assign the identical compiled regex
         _KEYSTR_TOKEN = re.compile(r"\['([^']+)'\]|\.([A-Za-z_]\w*)|\[(\d+)\]")
     keys = [a or b or c for a, b, c in _KEYSTR_TOKEN.findall(path_str or "")]
     if "grad_residual" in keys:

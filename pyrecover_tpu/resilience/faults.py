@@ -32,6 +32,10 @@ Injection sites (``check(site, **ctx)`` seams placed in production code):
     ckpt_snapshot     zerostall device→host snapshot     ctx: path, leaves
     ckpt_chunk_write  zerostall chunk store write        ctx: path, written
     ckpt_manifest_commit  zerostall durable-but-unpublished manifest  ctx: path
+    swap_fetch        serving hot-swap incremental chunk fetch  ctx: path,
+                      written (bytes fetched so far — the chaos drill's
+                      kill-mid-swap site; save_index 0 targets a process
+                      that never saves, e.g. a serving replica)
     loader_batch      data loader batch materialization  ctx: batch
     metadata_poll     maintenance watcher poll loop      ctx: base
 
@@ -124,13 +128,15 @@ class _Kill9DuringSave(_Fault):
     ``latest``. ``save_index`` picks which save of the run (1-based),
     ``after_bytes`` how deep into the stream the kill lands. ``site``
     optionally pins WHICH stage dies — the vanilla stream write
-    (``ckpt_write``, the default-compatible site) or any zerostall
+    (``ckpt_write``, the default-compatible site), any zerostall
     pipeline stage (``ckpt_snapshot`` mid device→host copy,
     ``ckpt_chunk_write`` mid chunk store write, ``ckpt_manifest_commit``
-    between the durable chunks and the manifest rename)."""
+    between the durable chunks and the manifest rename), or the serving
+    hot-swap fetch (``swap_fetch`` — a reader process; pass
+    ``save_index: 0`` since a serving replica never saves)."""
 
     sites = ("ckpt_write", "ckpt_snapshot", "ckpt_chunk_write",
-             "ckpt_manifest_commit")
+             "ckpt_manifest_commit", "swap_fetch")
     type_name = "kill9_during_save"
 
     def __init__(self, spec):

@@ -19,14 +19,20 @@ checkpoints (ROADMAP item 1):
   * :mod:`restore` — read-only ``.params`` restore from any
     vanilla/sharded/zerostall checkpoint, gated by the elastic
     preflight and placed for the serving mesh.
-  * :mod:`loadgen` — seeded Poisson load generator, the lockstep
-    baseline, and the format.sh serving smoke gate.
+  * :mod:`loadgen` — seeded Poisson load generator (fixed-count and
+    fixed-duration open-loop modes), the lockstep baseline, and the
+    format.sh serving smoke gate.
+  * :mod:`hotswap` — zero-downtime weight hot-swap: a registry watcher
+    + incremental digest-diff fetcher + double-buffered swap that keeps
+    a live replica tracking the training run's checkpoints (ROADMAP
+    item 2 — the train→serve distribution plane).
 
 Event catalog additions (documented in ``telemetry/__init__`` and the
 README event table): ``request_admitted``, ``request_done``,
-``kv_backpressure``, ``weights_loaded``; spans ``req_queue`` /
-``req_prefill`` / ``req_decode`` / ``serving_restore``; histograms
-``ttft_s`` / ``tpot_s`` / ``e2e_s``.
+``kv_backpressure``, ``weights_loaded``, ``weights_swap_begin`` /
+``weights_swap_done`` / ``weights_swap_rejected``, ``swap_fetch_bytes``;
+spans ``req_queue`` / ``req_prefill`` / ``req_decode`` /
+``serving_restore``; histograms ``ttft_s`` / ``tpot_s`` / ``e2e_s``.
 """
 
 from pyrecover_tpu.serving.engine import (
@@ -34,6 +40,7 @@ from pyrecover_tpu.serving.engine import (
     ServingConfig,
     ServingEngine,
 )
+from pyrecover_tpu.serving.hotswap import HotSwapper
 from pyrecover_tpu.serving.kvpool import (
     BlockPool,
     blocks_for,
@@ -43,6 +50,7 @@ from pyrecover_tpu.serving.kvpool import (
 )
 from pyrecover_tpu.serving.loadgen import (
     lockstep_baseline,
+    open_loop_workload,
     run_loadgen,
     sample_workload,
     serving_smoke,
@@ -55,6 +63,7 @@ from pyrecover_tpu.serving.restore import (
 
 __all__ = [
     "BlockPool",
+    "HotSwapper",
     "Request",
     "ServingConfig",
     "ServingEngine",
@@ -64,6 +73,7 @@ __all__ = [
     "kv_token_bytes",
     "load_serving_params",
     "lockstep_baseline",
+    "open_loop_workload",
     "paged_attention",
     "paged_forward",
     "resident_sequences",

@@ -179,10 +179,14 @@ class ServingEngine:
             )
         self.table_width = self.pool.table_width(self.max_model_len)
 
-        # the ONLY cross-thread state: submissions land here under _lock
+        # cross-thread state under _lock: the submission queue, plus the
+        # hot-swap staging slot (a fully-placed weights pytree waiting
+        # for the next step boundary — serving/hotswap/swap.py)
         self._lock = threading.Lock()
         self._waiting = []  # FIFO of QUEUED requests
         self._next_rid = 0
+        self._staged_swap = None  # set by install_params, consumed by _pump
+        self.weights_step = None  # step of the serving weights, if known
 
         # single-consumer scheduler state (see the threading contract in
         # the module docstring: exactly one pump thread mutates these)
@@ -254,6 +258,46 @@ class ServingEngine:
         """Finished request's token ids (prompt + generated), or None."""
         req = self._done.get(rid)
         return req.result() if req is not None else None
+
+    # ---- zero-downtime weight hot-swap (serving/hotswap) --------------
+
+    def install_params(self, params, *, step=None, info=None):  # jaxlint: host-only
+        """Stage a new, fully-placed weights pytree for an atomic swap at
+        the next step boundary. Thread-safe (the hot-swap watcher calls
+        this from its own thread); only a reference is stored under the
+        lock — assembly, verification, and device placement all happened
+        on the caller's thread (the double-buffer discipline). The pump
+        flips ``self.params`` between scheduler passes, so in-flight
+        requests never see mixed weights; a second install before the
+        flip replaces the first (latest wins — stale weights are never
+        worth serving). The pytree must be shape-stable with the current
+        params (the swapper checks) so the compiled prefill/decode
+        programs are reused with zero retraces."""
+        with self._lock:
+            self._staged_swap = {
+                "params": params, "step": step, "info": dict(info or {}),
+                "t_staged": time.monotonic(),
+            }
+
+    def _apply_staged_swap(self):
+        """Step-boundary flip (pump thread only): consume the staged
+        weights and emit ``weights_swap_done`` once they are live."""
+        with self._lock:
+            staged, self._staged_swap = self._staged_swap, None
+        if staged is None:
+            return False
+        self.params = staged["params"]
+        self.weights_step = staged["step"]
+        info = staged["info"]
+        t_begin = info.pop("t_begin", staged["t_staged"])
+        telemetry.emit(
+            "weights_swap_done", step=staged["step"],
+            swap_s=round(time.monotonic() - t_begin, 6),
+            in_flight=sum(1 for s in self._slots if s is not None),
+            **info,
+        )
+        metrics.counter("weights_swaps_total").inc()
+        return True
 
     # ---- scheduling (single consumer) --------------------------------
 
@@ -334,7 +378,10 @@ class ServingEngine:
                 self._stop.wait(0.001)
 
     def _pump(self):
-        progressed = self._admit()
+        # a staged hot-swap applies FIRST, so the whole pass (prefill +
+        # decode) runs against one coherent weights reference
+        progressed = self._apply_staged_swap()
+        progressed = self._admit() or progressed
         progressed = self._do_prefill() or progressed
         progressed = self._do_decode() or progressed
         return progressed

@@ -1,0 +1,41 @@
+"""pyrecover_tpu.serving.hotswap — zero-downtime weight hot-swap.
+
+The train→serve distribution plane (ROADMAP item 2): a live serving
+replica tracks the training run's checkpoint registry, fetches only the
+chunks whose content digests changed since the loaded manifest, verifies
+every byte, and flips its weights reference between decode steps with
+in-flight requests untouched.
+
+  * :mod:`swap` — :class:`HotSwapper`: the registry watcher (bounded-
+    join polling thread), the incremental-vs-full fetch dispatch, the
+    pin-guarded fetch window, shape-stability (zero-retrace) checking,
+    and the loud ``weights_swap_rejected`` failure path.
+  * :mod:`fetch` — the chunk-digest diff (``diff_manifest_chunks``, also
+    the ``inspect_checkpoint --diff-manifests`` surface) and the
+    digest-verified incremental assembly.
+  * :mod:`drill` — the format.sh proof harness: the one-process
+    train-and-serve smoke and the SIGKILL-mid-swap chaos drill, plus
+    the drill's server subprocess entry.
+
+Event catalog additions (documented in ``telemetry/__init__`` and the
+README event table): ``weights_swap_begin`` / ``weights_swap_done`` /
+``weights_swap_rejected`` / ``swap_fetch_bytes``.
+"""
+
+from pyrecover_tpu.serving.hotswap.drill import (
+    hotswap_chaos_drill,
+    hotswap_smoke,
+)
+from pyrecover_tpu.serving.hotswap.fetch import (
+    diff_manifest_chunks,
+    fetch_params_incremental,
+)
+from pyrecover_tpu.serving.hotswap.swap import HotSwapper
+
+__all__ = [
+    "HotSwapper",
+    "diff_manifest_chunks",
+    "fetch_params_incremental",
+    "hotswap_chaos_drill",
+    "hotswap_smoke",
+]

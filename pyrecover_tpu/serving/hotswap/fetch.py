@@ -1,0 +1,183 @@
+"""Incremental weight fetch: move only the chunks whose digests changed.
+
+The zerostall chunk store is content-addressed (BLAKE2b-128 per chunk,
+``checkpoint/zerostall/chunkstore.py``), which makes a manifest diff the
+exact transfer plan: a chunk whose digest appears in BOTH the loaded and
+the new manifest is already in the replica's RAM — it costs zero reads —
+and only changed chunks touch the store. Late-training saves move a
+small fraction of the state (embeddings and slow-movers dedup away), so
+a hot swap's fetch cost tracks what actually trained, not model size.
+
+Verification is structural, not optional: every byte that enters the
+assembled leaf is digest-checked against the NEW manifest — fetched
+chunks through ``ChunkStore.get`` (the address IS the checksum), reused
+chunks by recomputing the digest over the cached bytes (a serving
+process that corrupted its own cache must not survive the swap). Any
+mismatch raises; the swapper turns that into a loud
+``weights_swap_rejected`` and keeps serving the old weights.
+
+``diff_manifest_chunks`` is also the operator surface: the
+``tools/inspect_checkpoint.py --diff-manifests A B`` view of what a swap
+(or an incremental save) between two manifests costs.
+"""
+
+import numpy as np
+
+from pyrecover_tpu.checkpoint.zerostall.chunkstore import (
+    ChunkStore,
+    chunk_digest,
+    expected_chunk_sizes,
+)
+from pyrecover_tpu.resilience import faults
+
+
+def diff_manifest_chunks(old_doc, new_doc, *, prefix=None):
+    """Per-leaf chunk-digest diff between two zerostall manifest docs.
+
+    Returns ``{"leaves": [...], ...totals}`` where each leaf row carries
+    ``chunks_total`` / ``chunks_changed`` / ``fetch_bytes`` /
+    ``reused_bytes`` against the OLD manifest (a leaf absent there, or
+    chunked at a different ``chunk_bytes``, is all-changed — digests at
+    different chunk sizes are not comparable). ``prefix`` restricts to
+    one manifest-path subtree (the fetcher passes ``.params``)."""
+    old_by_path = {e["path"]: e for e in old_doc.get("leaves", [])}
+    rows = []
+    totals = {"fetch_bytes": 0, "reused_bytes": 0,
+              "chunks_changed": 0, "chunks_total": 0}
+    for entry in new_doc.get("leaves", []):
+        if prefix and not entry["path"].startswith(prefix):
+            continue
+        sizes = expected_chunk_sizes(
+            int(entry["nbytes"]), int(entry["chunk_bytes"])
+        )
+        old = old_by_path.get(entry["path"])
+        comparable = (
+            old is not None
+            and int(old.get("chunk_bytes", -1)) == int(entry["chunk_bytes"])
+        )
+        old_chunks = old["chunks"] if comparable else []
+        changed = [
+            i for i, d in enumerate(entry["chunks"])
+            if i >= len(old_chunks) or old_chunks[i] != d
+        ]
+        fetch = sum(sizes[i] for i in changed)
+        row = {
+            "path": entry["path"],
+            "nbytes": int(entry["nbytes"]),
+            "chunks_total": len(entry["chunks"]),
+            "chunks_changed": len(changed),
+            "fetch_bytes": fetch,
+            "reused_bytes": int(entry["nbytes"]) - fetch,
+            "changed": bool(changed),
+            "new_leaf": old is None,
+        }
+        rows.append(row)
+        totals["fetch_bytes"] += row["fetch_bytes"]
+        totals["reused_bytes"] += row["reused_bytes"]
+        totals["chunks_changed"] += row["chunks_changed"]
+        totals["chunks_total"] += row["chunks_total"]
+    return {
+        "leaves": rows,
+        "changed_leaves": sum(1 for r in rows if r["changed"]),
+        "num_leaves": len(rows),
+        **totals,
+    }
+
+
+def fetch_leaf_incremental(store, entry, old_entry, old_bytes, *,  # jaxlint: host-only
+                           manifest_path, stats):
+    """Assemble one leaf's host array for the NEW manifest ``entry``,
+    reusing chunks whose digests match ``old_entry`` out of the cached
+    ``old_bytes`` (a contiguous byte view of the loaded leaf) and
+    fetching the rest from ``store``. EVERY chunk is digest-verified
+    before it enters the buffer — reused ones by recomputation, fetched
+    ones inside ``store.get``. Raises on any mismatch."""
+    chunk_bytes = int(entry["chunk_bytes"])
+    sizes = expected_chunk_sizes(int(entry["nbytes"]), chunk_bytes)
+    if len(sizes) != len(entry["chunks"]):
+        raise ValueError(
+            f"{entry['path']}: manifest lists {len(entry['chunks'])} "
+            f"chunks, layout expects {len(sizes)}"
+        )
+    comparable = (
+        old_entry is not None
+        and old_bytes is not None
+        and int(old_entry.get("chunk_bytes", -1)) == chunk_bytes
+        and len(old_bytes) == int(old_entry.get("nbytes", -1))
+    )
+    old_chunks = old_entry["chunks"] if comparable else []
+    buf = bytearray(int(entry["nbytes"]))
+    off = 0
+    for i, (digest, size) in enumerate(zip(entry["chunks"], sizes)):
+        reused = False
+        if i < len(old_chunks) and old_chunks[i] == digest:
+            cached = bytes(old_bytes[off:off + size])
+            # re-verify before assembly: the cache is this process's own
+            # RAM, and a swap must never launder a local corruption into
+            # "verified" weights
+            if chunk_digest(cached) == digest:
+                buf[off:off + size] = cached
+                stats["reused_bytes"] += size
+                stats["chunks_reused"] += 1
+                reused = True
+        if not reused:
+            faults.check(
+                "swap_fetch", path=str(manifest_path),
+                written=stats["fetched_bytes"],
+            )
+            buf[off:off + size] = store.get(digest, expected_len=size)
+            stats["fetched_bytes"] += size
+            stats["chunks_fetched"] += 1
+        off += size
+    from pyrecover_tpu.checkpoint.vanilla import _dtype_from_str
+
+    count = (
+        int(np.prod(entry["shape"], dtype=np.int64)) if entry["shape"] else 1
+    )
+    arr = np.frombuffer(bytes(buf), dtype=_dtype_from_str(entry["dtype"]),
+                        count=count)
+    return arr.reshape(entry["shape"])
+
+
+def fetch_params_incremental(exp_dir, new_doc, old_doc, old_host, *,  # jaxlint: host-only
+                             manifest_path, prefix=".params"):
+    """Fetch the ``prefix`` subtree of ``new_doc`` incrementally against
+    the loaded manifest ``old_doc`` + its cached host bytes ``old_host``
+    (``{manifest path: np.ndarray}``). Returns ``(flat, stats)`` where
+    ``flat`` is ``[(path, array)]`` in manifest order and ``stats`` the
+    fetched/reused byte ledger. ``old_doc``/``old_host`` may be None —
+    everything is then fetched (still digest-verified)."""
+    store = ChunkStore(exp_dir)
+    old_by_path = {
+        e["path"]: e for e in (old_doc or {}).get("leaves", [])
+    }
+    old_host = old_host or {}
+    stats = {"fetched_bytes": 0, "reused_bytes": 0,
+             "chunks_fetched": 0, "chunks_reused": 0,
+             "changed_leaves": 0, "leaves": 0}
+    flat = []
+    for entry in new_doc.get("leaves", []):
+        path = entry["path"]
+        if prefix and not path.startswith(prefix):
+            continue
+        old_entry = old_by_path.get(path)
+        cached = old_host.get(path)
+        old_bytes = (
+            memoryview(np.ascontiguousarray(cached).view(np.uint8)).cast("B")
+            if cached is not None else None
+        )
+        before = stats["chunks_fetched"]
+        arr = fetch_leaf_incremental(
+            store, entry, old_entry, old_bytes,
+            manifest_path=manifest_path, stats=stats,
+        )
+        stats["leaves"] += 1
+        if stats["chunks_fetched"] > before:
+            stats["changed_leaves"] += 1
+        flat.append((path, arr))
+    if not flat:
+        raise ValueError(
+            f"manifest {manifest_path} carries no {prefix!r} leaves — "
+            "not a training-state checkpoint a serving replica can swap to"
+        )
+    return flat, stats

@@ -48,6 +48,35 @@ def sample_workload(n_requests, *, vocab_size, max_model_len, seed=0,
     return reqs
 
 
+def open_loop_workload(duration_s, *, vocab_size, max_model_len, seed=0,
+                       prompt_lens=(4, 48), new_tokens=(1, 24),
+                       arrival_rate=50.0):
+    """Fixed-duration open-loop mix: Poisson arrivals at
+    ``arrival_rate`` req/s for ``duration_s`` seconds — the request
+    COUNT is whatever the seeded arrival process produces, which is what
+    makes tail-latency comparisons over a controlled window honest (a
+    fixed request count would let a slow server shrink its own offered
+    load). Deterministic in ``seed``: the hot-swap drills run the same
+    workload against the swapping and the no-swap engine and compare
+    p99 over the identical window."""
+    rng = np.random.default_rng(seed)
+    reqs = []
+    t = 0.0
+    while True:
+        t += float(rng.exponential(1.0 / arrival_rate))
+        if t >= duration_s:
+            return reqs
+        p_len = int(rng.integers(prompt_lens[0], prompt_lens[1] + 1))
+        n_new = int(rng.integers(new_tokens[0], new_tokens[1] + 1))
+        if p_len + n_new > max_model_len:
+            p_len = max_model_len - n_new
+        reqs.append({
+            "prompt": rng.integers(0, vocab_size, (p_len,)).tolist(),
+            "max_new_tokens": n_new,
+            "arrival_s": t,
+        })
+
+
 def _percentiles(hist):
     return {
         "p50": hist.percentile(0.50),

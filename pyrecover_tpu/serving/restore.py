@@ -78,8 +78,25 @@ def _nest(flat):
 
 
 def _read_params_vanilla(path):
-    from pyrecover_tpu.checkpoint.vanilla import read_ckpt_raw
+    from pyrecover_tpu.checkpoint.vanilla import (
+        _sidecar,
+        read_ckpt_raw,
+        verify_checksum,
+    )
 
+    # tamper gate: the framed container catches truncation and length
+    # drift structurally, but a flipped byte INSIDE a tensor frame
+    # decodes silently — when the save left a checksum sidecar, verify
+    # it before any leaf is decoded (and long before placement)
+    sidecar = _sidecar(Path(path))
+    if sidecar.exists():
+        expected = sidecar.read_text().strip()
+        if expected and not verify_checksum(path, expected):
+            raise ServingRestoreError(
+                f"checkpoint {Path(path).name} fails its checksum sidecar "
+                "— file tampered or bit-flipped after save; refusing to "
+                "serve from it"
+            )
     _, paths, leaves = read_ckpt_raw(path)
     flat = [
         (_keystr_parts(p)[1:], np.asarray(leaf))
@@ -111,7 +128,13 @@ def _read_params_zerostall(path):
 
 def _read_params_sharded(path):
     """Raw (target-free) Orbax read of the ``state`` item; returns the
-    ``params`` subtree as host arrays."""
+    ``params`` subtree as host arrays. Verifies each leaf against the
+    content digests the save recorded in the ``meta`` item (Orbax's raw
+    read detects NO tensor corruption of its own — measured: a flipped
+    tensorstore byte loads silently) — a mismatch raises before any
+    placement."""
+    import json
+
     import orbax.checkpoint as ocp
 
     with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
@@ -119,10 +142,29 @@ def _read_params_sharded(path):
     params = tree["params"] if isinstance(tree, dict) else tree.params
     import jax
 
-    flat = [
-        (_keystr_parts(jax.tree_util.keystr(p)), np.asarray(leaf))
-        for p, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
-    ]
+    meta_file = Path(path) / "meta" / "metadata"
+    digests = {}
+    if meta_file.exists():
+        try:
+            digests = json.loads(meta_file.read_text()).get(
+                "leaf_digests"
+            ) or {}
+        except ValueError:
+            digests = {}
+    from pyrecover_tpu.checkpoint.zerostall.chunkstore import leaf_digest
+
+    flat = []
+    for p, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        key = jax.tree_util.keystr(p)
+        arr = np.asarray(leaf)
+        expected = digests.get(f"{PARAMS_PREFIX}{key}")
+        if expected is not None and leaf_digest(arr) != expected:
+            raise ServingRestoreError(
+                f"checkpoint {Path(path).name}: leaf .params{key} fails "
+                "its recorded content digest — tensorstore file tampered "
+                "or bit-flipped after save; refusing to serve from it"
+            )
+        flat.append((_keystr_parts(key), arr))
     return _nest(flat)
 
 

@@ -109,6 +109,25 @@ Core event names across the stack (fields beyond the envelope):
                       target_topology (the serving engine restored the
                       .params subtree read-only from a checkpoint,
                       preflighted and placed for the serving mesh)
+    weights_swap_begin  path, engine, from_step, to_step (the hot-swap
+                      watcher found a newer committed checkpoint and
+                      started fetching; serving continues on the old
+                      weights throughout)
+    weights_swap_done  step, swap_s, in_flight, path, engine, from_step,
+                      fetched_bytes, reused_bytes (the serving engine
+                      flipped its params reference at a step boundary —
+                      swap_s covers fetch+verify+place+flip, in_flight
+                      the requests that rode through untouched)
+    weights_swap_rejected  path, engine, from_step, to_step, reason (a
+                      fetch/digest/shape-stability failure: the manifest
+                      is remembered as rejected — no retry loop — and
+                      the replica keeps serving the old weights)
+    swap_fetch_bytes  path, incremental, fetched_bytes, reused_bytes,
+                      chunks_fetched, chunks_reused, changed_leaves,
+                      leaves (the swap's transfer ledger: an incremental
+                      zerostall fetch moves only changed-digest chunks;
+                      vanilla/sharded fall back to a full read with
+                      reused_bytes 0)
     ckpt_policy       step, source, engine, interval_steps,
                       prev_interval_steps, optimum_steps, optimum_s,
                       cost_s, mtti_s, step_iter_s, failures_observed,

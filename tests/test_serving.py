@@ -690,7 +690,7 @@ def test_serving_host_apis_are_host_only_marked():
 
     expected = {
         "engine.py": {"submit", "result", "step", "run_until_drained",
-                      "start", "stop"},
+                      "start", "stop", "install_params"},
         "kvpool.py": {"alloc", "release", "check_drained", "from_budget"},
         "restore.py": {"load_serving_params"},
         "loadgen.py": {"run_loadgen", "lockstep_baseline",

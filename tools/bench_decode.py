@@ -16,6 +16,13 @@ Three modes, all printing exactly ONE JSON line on stdout:
     serving restore → load generator on virtual devices, asserting
     greedy equality vs lockstep, zero leaked KV blocks at drain, and a
     non-empty latency report. Exit 1 on any violation.
+  * ``--hotswap-smoke DIR`` — the format.sh hot-swap gate
+    (``pyrecover_tpu/serving/hotswap/drill.py``): the one-process
+    train-and-serve smoke (≥1 live swap, token equality vs a cold
+    restore of the final manifest, incremental fetch accounting, p99
+    across the swap window) followed by the SIGKILL-mid-swap chaos
+    drill (restart serves the old manifest, pin-guarded GC, zero torn
+    state). Exit 1 on any violation.
 
 Run (tunnel up): python tools/bench_decode.py [--serving] [--batch 8] ...
 """
@@ -178,6 +185,9 @@ def main():
                     help="continuous-batching loadgen bench")
     ap.add_argument("--smoke", metavar="DIR", default=None,
                     help="format.sh serving gate (tiny model, asserts)")
+    ap.add_argument("--hotswap-smoke", metavar="DIR", default=None,
+                    help="format.sh hot-swap gate: train-and-serve smoke "
+                    "+ SIGKILL-mid-swap chaos drill")
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--arrival-rate", type=float, default=100.0)
@@ -193,6 +203,19 @@ def main():
 
         report = serving_smoke(args.smoke, seed=args.seed)
         print(json.dumps({"metric": "serving_smoke", "ok": True,
+                          **report}, default=str))
+        return
+
+    if args.hotswap_smoke is not None:
+        from pyrecover_tpu.serving.hotswap import (
+            hotswap_chaos_drill,
+            hotswap_smoke,
+        )
+
+        work = Path(args.hotswap_smoke)
+        report = hotswap_smoke(work, seed=args.seed)
+        report["chaos"] = hotswap_chaos_drill(work, seed=args.seed)
+        print(json.dumps({"metric": "hotswap_smoke", "ok": True,
                           **report}, default=str))
         return
 

@@ -5,12 +5,19 @@ data-order state, leaf count/shapes/dtypes/pspecs/bytes.
 Usage: python tools/inspect_checkpoint.py PATH [--leaves] [--manifest]
        python tools/inspect_checkpoint.py PATH --reshard-plan --devices N
            [--mesh data=2,fsdp=2] [--json]
+       python tools/inspect_checkpoint.py --diff-manifests A B [--json]
 
 ``--manifest`` prints the checkpoint's schema manifest as JSON — the
 exact document ``pyrecover_tpu.analysis.shardcheck`` diffs at preflight/
 resume (``shardcheck --diff-checkpoint``), read from the meta header
 alone (no tensor data). The human ``--leaves`` listing renders the same
 manifest, so the two surfaces cannot drift.
+
+``--diff-manifests A B`` diffs two zerostall manifests' per-leaf chunk
+digests — the operator view of what a hot swap (or an incremental save)
+between them costs: changed vs unchanged leaves, bytes a replica must
+fetch, bytes its loaded copy already covers. Text by default, the raw
+``diff_manifest_chunks`` document with ``--json``.
 
 ``--reshard-plan --devices N`` dry-runs a topology-elastic resume onto
 an N-device mesh from the manifest alone — per-leaf source→target shard
@@ -290,6 +297,53 @@ def reshard_plan_main(path, devices, mesh_arg, as_json):
     return 0 if not findings else 1
 
 
+def diff_manifests_main(path_a, path_b, as_json):
+    """Chunk-digest diff of two zerostall manifests: per-leaf changed/
+    unchanged state and the bytes-to-fetch a hot swap between them would
+    move. Exit 0 on success, 2 when either path is not a parseable
+    zerostall manifest."""
+    from pyrecover_tpu.checkpoint.registry import engine_of
+    from pyrecover_tpu.checkpoint.zerostall.chunkstore import read_manifest
+    from pyrecover_tpu.serving.hotswap.fetch import diff_manifest_chunks
+
+    docs = []
+    for p in (path_a, path_b):
+        p = Path(p)
+        if engine_of(p) != "zerostall":
+            print(f"ERROR: {p} is not a zerostall manifest (chunk-digest "
+                  "diffs need the content-addressed engine)",
+                  file=sys.stderr)
+            return 2
+        try:
+            docs.append(read_manifest(p))
+        except Exception as e:
+            print(f"ERROR: cannot read {p}: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return 2
+    diff = diff_manifest_chunks(docs[0], docs[1])
+    if as_json:
+        print(json.dumps(diff, indent=2))
+        return 0
+    print(f"manifest diff: {Path(path_a).name} -> {Path(path_b).name}")
+    print(f"leaves: {diff['num_leaves']} total, "
+          f"{diff['changed_leaves']} changed")
+    for row in diff["leaves"]:
+        state = (
+            "NEW" if row["new_leaf"]
+            else f"{row['chunks_changed']}/{row['chunks_total']} chunks"
+            if row["changed"] else "unchanged"
+        )
+        print(f"  {row['path']}: {state} | fetch {human(row['fetch_bytes'])}"
+              f", reuse {human(row['reused_bytes'])}")
+    total = diff["fetch_bytes"] + diff["reused_bytes"]
+    pct = 100.0 * diff["fetch_bytes"] / total if total else 0.0
+    print(f"bytes to fetch: {human(diff['fetch_bytes'])} of {human(total)} "
+          f"({pct:.1f}%) | reused in place: {human(diff['reused_bytes'])} "
+          f"| chunks {diff['chunks_changed']}/{diff['chunks_total']} "
+          "changed")
+    return 0
+
+
 def _die_quietly_on_sigpipe():
     """Behave like a unix tool when piped into head & co. Script-entry
     only: main() is also called IN-PROCESS by tests, and resetting the
@@ -304,8 +358,14 @@ def _die_quietly_on_sigpipe():
 
 def main(argv=None):
     ap = argparse.ArgumentParser()
-    ap.add_argument("checkpoint")
+    ap.add_argument("checkpoint", nargs="?", default=None)
     ap.add_argument("--leaves", action="store_true", help="list every leaf")
+    ap.add_argument(
+        "--diff-manifests", nargs=2, metavar=("A", "B"), default=None,
+        help="per-leaf changed/unchanged chunk-digest diff and "
+        "bytes-to-fetch between two zerostall manifests — what a hot "
+        "swap between them costs (text; --json for the raw document)",
+    )
     ap.add_argument("--chunks", action="store_true",
                     help="zerostall checkpoints: list every leaf's chunk "
                     "digests with dedup/presence state (the chunk view)")
@@ -326,8 +386,12 @@ def main(argv=None):
                     help="target mesh axis sizes for --reshard-plan, e.g. "
                     "data=2,fsdp=2 (default: pure data parallelism)")
     ap.add_argument("--json", action="store_true",
-                    help="with --reshard-plan: emit the plan as JSON")
+                    help="with --reshard-plan/--diff-manifests: emit JSON")
     args = ap.parse_args(argv)
+    if args.diff_manifests:
+        return diff_manifests_main(*args.diff_manifests, args.json)
+    if args.checkpoint is None:
+        ap.error("checkpoint path required (or use --diff-manifests A B)")
     p = Path(args.checkpoint)
     if not p.exists():
         print(f"ERROR: {p} does not exist", file=sys.stderr)

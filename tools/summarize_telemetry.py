@@ -15,6 +15,9 @@ renders:
     the static-policy counterfactual — what the configured static
     interval would have lost on the SAME event stream (interval-spaced
     saves at the measured mean blocking cost + per-death replay);
+  * the serving hot-swap trail (``weights_swap_*`` / ``swap_fetch_bytes``:
+    swap count, bytes fetched vs reused in place, request p99 across the
+    swap windows);
   * preemption / maintenance / data-stall event digests.
 
 ``--json OUT`` additionally writes a BENCH-compatible blob
@@ -320,6 +323,58 @@ def aggregate(events):
             ],
         }
     agg["serving"] = serving
+
+    # hot-swap rollup: the train→serve distribution plane's trail —
+    # completed/rejected swaps, the incremental fetch ledger (bytes
+    # moved vs bytes the replica already held), swap-apply latency, and
+    # request p99 ACROSS the swap windows (requests finishing between a
+    # weights_swap_begin and 1s past its weights_swap_done — the tail
+    # the zero-downtime claim is about)
+    swap_done = by.get("weights_swap_done", [])
+    swap_rejected = by.get("weights_swap_rejected", [])
+    swap_fetches = by.get("swap_fetch_bytes", [])
+    hotswap = {}
+    if swap_done or swap_rejected or swap_fetches:
+        windows = []
+        begins_by_step = {
+            e.get("to_step"): e["ts"]
+            for e in by.get("weights_swap_begin", [])
+        }
+        for e in swap_done:
+            start = begins_by_step.get(e.get("step"), e["ts"])
+            windows.append((start, e["ts"] + 1.0))
+        in_window = [
+            (float(e["e2e_s"]), 1) for e in done
+            if isinstance(e.get("e2e_s"), (int, float))
+            and any(a <= e["ts"] <= b for a, b in windows)
+        ]
+        swap_s = [
+            (float(e["swap_s"]), 1) for e in swap_done
+            if isinstance(e.get("swap_s"), (int, float))
+        ]
+        hotswap = {
+            "swaps": len(swap_done),
+            "rejected": len(swap_rejected),
+            "rejected_reasons": [
+                {"path": e.get("path"), "reason": e.get("reason")}
+                for e in swap_rejected
+            ],
+            "fetched_bytes": sum(
+                int(e.get("fetched_bytes", 0)) for e in swap_fetches
+            ),
+            "reused_bytes": sum(
+                int(e.get("reused_bytes", 0)) for e in swap_fetches
+            ),
+            "incremental_fetches": sum(
+                1 for e in swap_fetches if e.get("incremental")
+            ),
+            "last_step": swap_done[-1].get("step") if swap_done else None,
+            "swap_s_p50": _wpercentile(swap_s, 0.50),
+            "swap_s_p99": _wpercentile(swap_s, 0.99),
+            "swap_window_requests": len(in_window),
+            "swap_window_e2e_p99": _wpercentile(in_window, 0.99),
+        }
+    agg["hotswap"] = hotswap
 
     # checkpoint-policy (autopilot) rollup + the static-policy
     # counterfactual: replay the SAME event stream against the configured
@@ -639,6 +694,28 @@ def render(agg, out=None):
             w(f"  weights loaded     {wl.get('engine')} checkpoint @ step "
               f"{wl.get('step')} ({wl.get('leaves')} leaves, "
               f"{wl.get('resharded_leaves')} resharded)\n")
+    hs = agg.get("hotswap") or {}
+    if hs:
+        w("\n-- hot-swap (train→serve weights) ------------------------------\n")
+        w(f"  swaps              {hs['swaps']} completed, "
+          f"{hs['rejected']} rejected (serving @ step "
+          f"{hs['last_step']})\n")
+        total = hs["fetched_bytes"] + hs["reused_bytes"]
+        pct = 100.0 * hs["reused_bytes"] / total if total else 0.0
+        w(f"  bytes fetched      {hs['fetched_bytes'] / 2**20:.2f} MiB "
+          f"({hs['reused_bytes'] / 2**20:.2f} MiB reused in place — "
+          f"{pct:.1f}% of the state never moved)\n")
+        if hs.get("swap_s_p50") is not None:
+            w(f"  swap apply         p50 {hs['swap_s_p50'] * 1e3:.2f}ms  "
+              f"p99 {hs['swap_s_p99'] * 1e3:.2f}ms "
+              f"(fetch+verify+place, off the serve loop)\n")
+        if hs.get("swap_window_e2e_p99") is not None:
+            w(f"  p99 across swaps   "
+              f"{hs['swap_window_e2e_p99'] * 1e3:.2f}ms e2e over "
+              f"{hs['swap_window_requests']} request(s) finishing in a "
+              f"swap window\n")
+        for r in hs.get("rejected_reasons", []):
+            w(f"  REJECTED           {r['path']}: {r['reason']}\n")
     ds = agg["data_stalls"]
     if ds["count"]:
         w(f"\n-- data loader: {ds['count']} stall(s), {ds['wait_s']}s waiting "
@@ -687,6 +764,7 @@ def main(argv=None):
                 "wire": agg["wire"],
                 "autopilot": agg["autopilot"],
                 "serving": agg["serving"],
+                "hotswap": agg["hotswap"],
                 "data_stalls": agg["data_stalls"],
                 "preempt": agg["preempt"],
             },
