@@ -240,8 +240,16 @@ PYEOF
 # every request's greedy output is token-for-token equal to lockstep
 # generate_tokens, (b) every KV block is back on the free list at drain
 # (zero leaks — asserted inside the smoke), and (c) the latency report is
-# non-empty. The smoke's telemetry shard is then fed to
-# summarize_telemetry, which must render the request-latency percentiles.
+# non-empty. The smoke also serves its metrics registry over HTTP and
+# scrapes itself MID-RUN (>= half the requests finished, engine still
+# serving): the live scrape must render the key series non-zero —
+# serving tokens/sec, request p99, KV peak occupancy (README "Live
+# metrics"). The smoke's telemetry shard is then fed to
+# summarize_telemetry, which must render the request-latency percentiles
+# — and the live scrape's e2e p99 (a bucket-midpoint estimate) must
+# agree with the summarizer's exact request_done-derived p99 within one
+# histogram bucket width (grid base 2^0.25 ~ 19% relative, plus midpoint
+# slop: factor 1.25).
 SERVING_WORK="${SERVING_WORK:-/tmp/pyrecover_serving_smoke}"
 rm -rf "$SERVING_WORK"
 if SRV_OUT=$(JAX_PLATFORMS=cpu python tools/bench_decode.py \
@@ -256,15 +264,25 @@ assert rep["greedy_matches"] == rep["requests"], \
     "serving output diverged from lockstep decode"
 assert rep["tokens_per_sec"] and rep["ttft_s"]["p50"] is not None, \
     f"empty latency report: {rep}"
+mid = rep["live_scrape"]["mid"]
+for key in ("tokens_per_sec", "ttft_p50", "e2e_p99",
+            "kv_peak_occupancy_pct"):
+    assert mid.get(key), f"live mid-run scrape missing {key}: {mid}"
+assert mid["e2e_count"] >= rep["requests"] // 2, \
+    f"mid-run scrape saw too few finished requests: {mid}"
 print(f"serving smoke: OK — {rep['requests']} requests greedy-equal to "
-      f"lockstep at {rep['tokens_per_sec']} tok/s, zero leaked KV blocks")
+      f"lockstep at {rep['tokens_per_sec']} tok/s, zero leaked KV blocks; "
+      f"live scrape mid-run at {mid['e2e_count']}/{rep['requests']} done: "
+      f"{mid['tokens_per_sec']} tok/s, e2e p99 {mid['e2e_p99']}s, KV peak "
+      f"{mid['kv_peak_occupancy_pct']}%")
 PYEOF
 else
   echo "$SRV_OUT"
   rc=1
 fi
 if SRV_SUM=$(JAX_PLATFORMS=cpu python tools/summarize_telemetry.py \
-    "$SERVING_WORK/serving_telemetry.jsonl" 2>&1); then
+    "$SERVING_WORK/serving_telemetry.jsonl" \
+    --json "$SERVING_WORK/serving_summary.json" 2>&1); then
   if echo "$SRV_SUM" | grep -q "serving (request latency)" \
       && echo "$SRV_SUM" | grep -q "ttft"; then
     echo "$SRV_SUM" | grep -A 4 "serving (request latency)" | head -5
@@ -272,6 +290,21 @@ if SRV_SUM=$(JAX_PLATFORMS=cpu python tools/summarize_telemetry.py \
     echo "summarize_telemetry: serving request-latency section missing"
     rc=1
   fi
+  SRV_LINE="$SRV_LINE" python - "$SERVING_WORK/serving_summary.json" \
+      <<'PYEOF' || rc=1
+import json, os, sys
+rep = json.loads(os.environ["SRV_LINE"])
+blob = json.load(open(sys.argv[1]))
+exact = blob["extra"]["serving"]["e2e_s"]["p99"]
+live = rep["live_scrape"]["final"]["e2e_p99"]
+assert exact and live, (exact, live)
+ratio = max(live / exact, exact / live)
+assert ratio <= 1.25, (
+    f"live scrape p99 {live}s drifted {ratio:.3f}x from the post-hoc "
+    f"summarizer's exact p99 {exact}s (> one bucket width)")
+print(f"live-vs-posthoc: OK — scraped e2e p99 {live}s vs exact {exact}s "
+      f"({ratio:.3f}x, gate 1.25x = one bucket width + midpoint slop)")
+PYEOF
 else
   echo "$SRV_SUM"
   rc=1
@@ -312,18 +345,27 @@ assert rep["p99_e2e_s"] <= rep["p99_gate_s"], \
 ch = rep["chaos"]
 assert ch["kill_rc"] == -9 and ch["old_manifest_probe_equal"], ch
 assert not ch["quarantined"] and ch["chunks_leaked"] == 0, ch
+# the train-and-serve live scrape: all four key series, mid-run, from
+# one registry — trainer step time, serving throughput + tail, KV peak
+mid = rep["live_scrape"]["mid"]
+for key in ("tokens_per_sec", "step_iter_p50", "e2e_p99",
+            "kv_peak_occupancy_pct"):
+    assert mid.get(key), f"live mid-run scrape missing {key}: {mid}"
 print(f"hotswap smoke: OK — {rep['swaps']} live swaps token-equal to "
       f"cold restore ({rep['fetched_bytes']} B fetched / "
       f"{rep['reused_bytes']} B reused), p99 {rep['p99_e2e_s']}s <= gate "
       f"{rep['p99_gate_s']}s; chaos: kill mid-swap -> old manifest "
-      f"served, 0 quarantined, 0 leaked")
+      f"served, 0 quarantined, 0 leaked; live scrape mid-run: step p50 "
+      f"{mid['step_iter_p50']}s, {mid['tokens_per_sec']} tok/s, e2e p99 "
+      f"{mid['e2e_p99']}s, KV peak {mid['kv_peak_occupancy_pct']}%")
 PYEOF
 else
   echo "$HS_OUT"
   rc=1
 fi
 if HS_SUM=$(JAX_PLATFORMS=cpu python tools/summarize_telemetry.py \
-    "$HOTSWAP_WORK/hotswap_telemetry.jsonl" 2>&1); then
+    "$HOTSWAP_WORK/hotswap_telemetry.jsonl" \
+    --json "$HOTSWAP_WORK/hotswap_summary.json" 2>&1); then
   if echo "$HS_SUM" | grep -q "hot-swap" \
       && echo "$HS_SUM" | grep -q "bytes fetched" \
       && echo "$HS_SUM" | grep -q "p99 across swaps"; then
@@ -332,8 +374,57 @@ if HS_SUM=$(JAX_PLATFORMS=cpu python tools/summarize_telemetry.py \
     echo "summarize_telemetry: hot-swap section missing"
     rc=1
   fi
+  # live-vs-posthoc on the train-and-serve run: the final scrape's e2e
+  # p99 (bucket midpoint, swap-window registry) vs the summarizer's
+  # exact request_done-derived p99. The shard also carries the no-swap
+  # baseline window (identical workload, p99 within the drill's own
+  # gate), so the tolerance is one bucket width + midpoint slop + the
+  # two-window composition drift: factor 1.35.
+  HS_LINE="$HS_LINE" python - "$HOTSWAP_WORK/hotswap_summary.json" \
+      <<'PYEOF' || rc=1
+import json, os, sys
+rep = json.loads(os.environ["HS_LINE"])
+blob = json.load(open(sys.argv[1]))
+exact = blob["extra"]["serving"]["e2e_s"]["p99"]
+live = rep["live_scrape"]["final"]["e2e_p99"]
+assert exact and live, (exact, live)
+ratio = max(live / exact, exact / live)
+assert ratio <= 1.35, (
+    f"live scrape p99 {live}s drifted {ratio:.3f}x from the post-hoc "
+    f"summarizer's exact p99 {exact}s")
+print(f"live-vs-posthoc: OK — scraped e2e p99 {live}s vs exact {exact}s "
+      f"({ratio:.3f}x, gate 1.35x)")
+PYEOF
 else
   echo "$HS_SUM"
+  rc=1
+fi
+
+# live-metrics fleet drill: the aggregator's gate (pyrecover_tpu/
+# telemetry/aggregate). Spawns TWO genuinely separate exporter
+# subprocesses, scrapes both over real TCP, and fails (inside the drill)
+# unless the merged counters equal the exact sum of the parts, the
+# histogram merge is bucket-wise identical to one process observing all
+# samples, fleet p99 matches the single-process reference, and a
+# SIGKILLed target is reported STALE while its last-known totals keep
+# contributing to the fleet sums (flagged, never silently dropped).
+FLEET_WORK="${FLEET_WORK:-/tmp/pyrecover_fleet_drill}"
+rm -rf "$FLEET_WORK"
+if FLEET_OUT=$(JAX_PLATFORMS=cpu python -m pyrecover_tpu.telemetry.aggregate \
+    --drill "$FLEET_WORK" 2>&1); then
+  FLEET_LINE=$(echo "$FLEET_OUT" | tail -1)
+  FLEET_LINE="$FLEET_LINE" python - <<'PYEOF' || rc=1
+import json, os
+rep = json.loads(os.environ["FLEET_LINE"])
+assert rep["targets"] == 2 and rep["merged_requests_total"] == 12, rep
+assert rep["stale_after_kill"] == [rep["killed"]], rep
+print(f"fleet drill: OK — 2 subprocess endpoints merged over TCP "
+      f"(requests_total {rep['merged_requests_total']} = 7 + 5 exactly, "
+      f"lat p99 {rep['lat_p99']}s bucket-wise-exact); SIGKILLed "
+      f"{rep['killed']} reported stale, totals retained")
+PYEOF
+else
+  echo "$FLEET_OUT"
   rc=1
 fi
 

@@ -533,7 +533,19 @@ class CheckpointAutopilot:
         # host adopts the broadcast value — the _resume verdict discipline
         chosen = int(broadcast_host0_scalar(chosen))
         self.interval_steps = chosen
+        # live plane: the policy state the dashboard renders (host-side
+        # dict writes; host 0 additionally carries the model's inputs)
+        telemetry.metrics.gauge("autopilot_interval_steps").set(chosen)
         if jax.process_index() == 0 and record is not None:
+            telemetry.metrics.gauge("autopilot_mtti_s").set(
+                record["mtti_s"]
+            )
+            telemetry.metrics.gauge("autopilot_cost_s").set(
+                record["cost_s"]
+            )
+            telemetry.metrics.gauge("autopilot_failures_observed").set(
+                record["failures_observed"]
+            )
             telemetry.emit("ckpt_policy", **record)
             self.history.estimates = {
                 "save_cost_s": {

@@ -201,6 +201,14 @@ class ServingEngine:
         self._thread = None
         self._stop = threading.Event()
 
+        # live-plane gauge state (pump thread only): rate-limit stamp,
+        # peak KV occupancy, and the rolling (ts, tokens_total) window
+        # the serving_tokens_per_sec gauge derives from
+        self._gauge_stamp = 0.0
+        self._peak_occupancy_pct = 0.0
+        self._tok_total = 0
+        self._tok_window = []
+
         def fwd(params, arrays, tokens, pos, tables):
             return paged_forward(
                 params, arrays, tokens, pos, tables, model_config,
@@ -370,6 +378,9 @@ class ServingEngine:
                 f"{timeout}s"
             )
         self._thread = None
+        # final partial interval: without this the metrics accumulated
+        # since the last periodic flush would never reach the stream
+        metrics.flush(reason="serving_stop")
 
     def _serve_loop(self):
         while not self._stop.is_set():
@@ -384,7 +395,43 @@ class ServingEngine:
         progressed = self._admit() or progressed
         progressed = self._do_prefill() or progressed
         progressed = self._do_decode() or progressed
+        self._update_gauges()
         return progressed
+
+    def _update_gauges(self):
+        """Refresh the live-plane serving gauges (KV occupancy and
+        backpressure headroom, active/queued depth, decode tokens/sec).
+        Pump thread only; rate-limited dict writes — no device sync."""
+        now = time.monotonic()
+        usable = self.pool.usable_blocks
+        occupancy = 100.0 * self.pool.held_blocks / max(usable, 1)
+        self._peak_occupancy_pct = max(self._peak_occupancy_pct, occupancy)
+        if now - self._gauge_stamp < 0.05:
+            return
+        self._gauge_stamp = now
+        g = metrics.gauge
+        g("kv_pool_free_blocks").set(self.pool.free_blocks)
+        g("kv_pool_usable_blocks").set(usable)
+        g("kv_pool_occupancy_pct").set(round(occupancy, 3))
+        g("kv_pool_peak_occupancy_pct").set(
+            round(self._peak_occupancy_pct, 3)
+        )
+        g("serving_active_seqs").set(
+            sum(1 for s in self._slots if s is not None)
+        )
+        with self._lock:
+            queued = len(self._waiting)
+        g("serving_queued").set(queued)
+        # decode rate over a short sliding window of cumulative totals
+        window = self._tok_window
+        window.append((now, self._tok_total))
+        while window and window[0][0] < now - 2.0:
+            window.pop(0)
+        dt = now - window[0][0]
+        if dt > 0:
+            g("serving_tokens_per_sec").set(
+                round((self._tok_total - window[0][1]) / dt, 2)
+            )
 
     # admission: a request is admitted only when a slot AND its whole
     # block footprint are available (no partial grants, no mid-flight
@@ -467,6 +514,8 @@ class ServingEngine:
                 req.t_first_token = time.monotonic()
                 req.tokens.append(first)
                 req.state = RUNNING
+                self._tok_total += 1
+                metrics.counter("serving_tokens_total").inc()
                 metrics.histogram("ttft_s").observe(
                     req.t_first_token - req.t_submit
                 )
@@ -511,6 +560,8 @@ class ServingEngine:
         for req in live:
             req.tokens.append(int(np.argmax(logits[req.slot])))
             self._maybe_finish(req)
+        self._tok_total += len(live)
+        metrics.counter("serving_tokens_total").inc(len(live))
         return True
 
     def _maybe_finish(self, req):

@@ -207,16 +207,45 @@ def _hotswap_smoke_body(workdir, mem, *, duration_s, n_saves, seed,
         gap = duration_s / (n_saves + 1)
         for i in range(2, final_step + 1):
             time.sleep(gap)
+            t_iter = time.monotonic()
             st = _perturb(st, i)
             _save_zs(exp, i, st)
+            # the trainer half's step cadence, into the same series the
+            # real train loop feeds — the live scrape's step-time p50
+            metrics.histogram("step_iter_s").observe(
+                time.monotonic() - t_iter
+            )
+            metrics.gauge("train_step").set(i)
+
+    # live telemetry plane over the WHOLE train-and-serve window: the
+    # exporter serves this process's registry over real TCP; one scrape
+    # lands mid-run (>= half the requests finished, trainer + swapper
+    # still live) and one post-drain — the format.sh gate checks both
+    # against the post-hoc summarizer
+    from pyrecover_tpu.serving.loadgen import live_scrape_digest
+    from pyrecover_tpu.telemetry.aggregate import scrape
+    from pyrecover_tpu.telemetry.exporter import MetricsExporter
+
+    exporter = MetricsExporter(port=0).start()
+    scrapes = {}
 
     trainer = threading.Thread(target=_trainer, name="hotswap-trainer")
     swapper.start()
     trainer.start()
     try:
         metrics.reset()
-        _, swap_report = run_loadgen(engine, workload)
+        _, swap_report = run_loadgen(
+            engine, workload,
+            mid_hook=lambda: scrapes.__setitem__(
+                "mid",
+                scrape(f"127.0.0.1:{exporter.port}", timeout_s=30.0),
+            ),
+        )
+        scrapes["final"] = scrape(
+            f"127.0.0.1:{exporter.port}", timeout_s=30.0
+        )
     finally:
+        exporter.stop()
         trainer.join(timeout=60.0)
         deadline = time.monotonic() + 30.0
         while (swapper.loaded_step < final_step
@@ -317,6 +346,11 @@ def _hotswap_smoke_body(workdir, mem, *, duration_s, n_saves, seed,
         "noswap_p99_e2e_s": round(base_p99, 6),
         "p99_gate_s": round(gate, 6),
         "duration_s": duration_s,
+        "live_scrape": {
+            "url": f"http://127.0.0.1:{exporter.port}",
+            "mid": live_scrape_digest(scrapes["mid"]),
+            "final": live_scrape_digest(scrapes["final"]),
+        },
     }
 
 

@@ -98,6 +98,10 @@ class HotSwapper:
             engine.weights_step = (
                 self._loaded_step if self._loaded_step >= 0 else None
             )
+        if self._loaded_step >= 0:
+            telemetry.metrics.gauge("hotswap_loaded_step").set(
+                self._loaded_step
+            )
 
         self._thread = None
         self._stop = threading.Event()
@@ -198,6 +202,9 @@ class HotSwapper:
             reason = f"{type(e).__name__}: {e}"
             with self._lock:
                 self._rejected[path.name] = reason
+                n_rejected = len(self._rejected)
+            telemetry.metrics.counter("hotswap_rejected_total").inc()
+            telemetry.metrics.gauge("hotswap_rejected").set(n_rejected)
             telemetry.emit(
                 "weights_swap_rejected", path=str(path),
                 engine=ckpt_engine, from_step=from_step, to_step=step,
@@ -219,6 +226,12 @@ class HotSwapper:
             self._loaded_step = step
             self._loaded_doc = new_doc
             self._host_cache = new_cache
+        # live plane: the swap state the dashboard renders (the engine's
+        # weights_swaps_total counter ticks when the flip lands)
+        telemetry.metrics.gauge("hotswap_loaded_step").set(step)
+        telemetry.metrics.gauge("hotswap_fetched_bytes").set(
+            stats["fetched_bytes"]
+        )
         return True
 
     # ---- fetch paths --------------------------------------------------

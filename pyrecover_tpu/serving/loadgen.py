@@ -85,10 +85,16 @@ def _percentiles(hist):
     }
 
 
-def run_loadgen(engine, workload, *, timeout_s=600.0):  # jaxlint: host-only
+def run_loadgen(engine, workload, *,  # jaxlint: host-only
+                timeout_s=600.0, mid_hook=None):
     """Submit ``workload`` at its arrival offsets from this (client)
     thread while ``engine``'s background loop serves; block until every
-    request drains. Returns the latency/throughput report."""
+    request drains. Returns the latency/throughput report.
+
+    ``mid_hook`` (optional) fires exactly once, mid-run: every request
+    is submitted, at least half have finished, and the engine is still
+    actively serving the rest — the live-scrape smoke's observation
+    point."""
     t0 = time.monotonic()
     rids = []
     engine.start()
@@ -102,12 +108,19 @@ def run_loadgen(engine, workload, *, timeout_s=600.0):  # jaxlint: host-only
             )
         deadline = time.monotonic() + timeout_s
         while engine.pending:
+            if mid_hook is not None and (
+                engine.pending <= len(workload) // 2
+            ):
+                hook, mid_hook = mid_hook, None
+                hook()
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"loadgen: {engine.pending} requests still pending "
                     f"after {timeout_s}s"
                 )
             time.sleep(0.002)
+        if mid_hook is not None:  # drained before the drain loop saw it
+            mid_hook()
     finally:
         engine.stop()
     wall_s = time.monotonic() - t0
@@ -151,6 +164,34 @@ def lockstep_baseline(params, config, workload, *, max_len):  # jaxlint: host-on
         "wall_s": round(wall_s, 4),
         "new_tokens": new_tokens,
         "tokens_per_sec": round(new_tokens / max(wall_s, 1e-9), 2),
+    }
+
+
+def live_scrape_digest(snap):  # jaxlint: host-only
+    """Compress one exporter scrape (``/snapshot.json``) to the key
+    series the live-scrape smoke gates on — the same four the README
+    "Live metrics" section leads with: tokens/sec, step-time p50,
+    request p99, KV occupancy."""
+    hists = snap.get("hists", {})
+    gauges = snap.get("gauges", {})
+
+    def pct(name, q):
+        return (hists.get(name) or {}).get(q)
+
+    return {
+        "seq": snap.get("seq"),
+        "tokens_per_sec": gauges.get("serving_tokens_per_sec"),
+        "train_tokens_per_sec": gauges.get("train_tokens_per_sec"),
+        "step_iter_p50": pct("step_iter_s", "p50"),
+        "step_iter_count": (hists.get("step_iter_s") or {}).get("count"),
+        "ttft_p50": pct("ttft_s", "p50"),
+        "e2e_p99": pct("e2e_s", "p99"),
+        "e2e_count": (hists.get("e2e_s") or {}).get("count"),
+        "kv_occupancy_pct": gauges.get("kv_pool_occupancy_pct"),
+        "kv_peak_occupancy_pct": gauges.get("kv_pool_peak_occupancy_pct"),
+        "backpressure_total": snap.get("counters", {}).get(
+            "serving_backpressure_total", 0
+        ),
     }
 
 
@@ -212,7 +253,27 @@ def _serving_smoke_body(workdir, *, n_requests, seed, kv_mode):
         max_model_len=engine.max_model_len, seed=seed,
         prompt_lens=(3, 24), new_tokens=(1, 12), arrival_rate=200.0,
     )
-    results, report = run_loadgen(engine, workload)
+    # live telemetry plane: serve the registry over real TCP for the
+    # whole run, scrape it MID-RUN (>= half the requests finished, the
+    # engine still serving) and once more post-drain — the format.sh
+    # gate asserts the key series against both
+    from pyrecover_tpu.telemetry.aggregate import scrape
+    from pyrecover_tpu.telemetry.exporter import MetricsExporter
+
+    exporter = MetricsExporter(port=0).start()
+    scrapes = {}
+    try:
+        results, report = run_loadgen(
+            engine, workload,
+            mid_hook=lambda: scrapes.__setitem__(
+                "mid", scrape(f"127.0.0.1:{exporter.port}", timeout_s=30.0)
+            ),
+        )
+        scrapes["final"] = scrape(
+            f"127.0.0.1:{exporter.port}", timeout_s=30.0
+        )
+    finally:
+        exporter.stop()
     engine.pool.check_drained()  # zero leaked blocks, loudly
 
     expected, _ = lockstep_baseline(
@@ -233,4 +294,9 @@ def _serving_smoke_body(workdir, *, n_requests, seed, kv_mode):
     report["restore"] = info
     report["greedy_matches"] = len(results) - len(mismatched)
     report["kv_mode"] = kv_mode
+    report["live_scrape"] = {
+        "url": f"http://127.0.0.1:{exporter.port}",
+        "mid": live_scrape_digest(scrapes["mid"]),
+        "final": live_scrape_digest(scrapes["final"]),
+    }
     return report

@@ -181,6 +181,20 @@ Tracing + metrics events (``spans.py`` / ``metrics.py``; see README
     metrics_snapshot  reason, counters{}, gauges{}, hists{name: {count,
                       sum, min, max, p50, p95, p99}}
 
+Live metrics plane (``exporter.py`` / ``aggregate.py``; README "Live
+metrics"): a per-process HTTP exposition endpoint over the metrics
+registry, a fleet aggregator that scrapes N endpoints over TCP, and
+SLO burn-rate alert rules evaluated on the exporter's serve thread:
+    exporter_started  host, port, url, rules[] (exposition endpoint up)
+    exporter_stopped  host, port, scrapes, uptime_s (bounded-join stop)
+    metrics_scrape    poll, targets, ok, stale, seconds (one aggregator
+                      sweep over its scrape targets)
+    slo_alert         rule, kind, state (firing|cleared), value,
+                      threshold, window_s, series (a burn-rate rule
+                      transitioned; ``slo_alerts_total`` counter rides
+                      along — summarizer "SLO alerts" section + doctor
+                      evidence both read this trail)
+
 ``tools/summarize_telemetry.py`` turns a run's JSONL into a goodput
 report; ``tools/traceview.py`` merges multi-host shards into a
 Perfetto-loadable Chrome trace + straggler/spike/regression analysis;

@@ -308,6 +308,48 @@ def analyze(evidence, *, recompile_storm_threshold=DEFAULT_RECOMPILE_STORM):
                 f"{len(records)} interruption(s) over the resume chain: "
                 + ", ".join(f"{k}×{v}" for k, v in sorted(by_kind.items())),
             )
+    # SLO alert trail (live metrics exporter): a death that follows
+    # sustained burn-rate alerting is symptom-first evidence — the run
+    # was already violating its latency/step-time/backpressure rules
+    # before it died. Surface each rule's trail as evidence, and any
+    # rule still FIRING at death as a finding next to the verdict.
+    slo_events = [e for e in seg if e.get("event") == "slo_alert"]
+    slo_alerts = None
+    if slo_events:
+        slo_rules = {}
+        for e in slo_events:
+            r = slo_rules.setdefault(e.get("rule", "?"), {
+                "kind": e.get("kind"), "threshold": e.get("threshold"),
+                "fires": 0, "clears": 0, "last_value": None,
+                "firing_at_end": False,
+            })
+            if e.get("state") == "firing":
+                r["fires"] += 1
+                r["last_value"] = e.get("value")
+                r["firing_at_end"] = True
+            elif e.get("state") == "cleared":
+                r["clears"] += 1
+                r["firing_at_end"] = False
+        slo_alerts = {
+            "events": len(slo_events),
+            "total_fires": sum(r["fires"] for r in slo_rules.values()),
+            "rules": slo_rules,
+        }
+        died = summary is None or summary.get("status") == "error"
+        for name, r in sorted(slo_rules.items()):
+            if died and r["firing_at_end"]:
+                finding(
+                    "slo_alert",
+                    f"rule '{name}' ({r['kind']}) was FIRING when the run "
+                    f"died — last value {r['last_value']} vs threshold "
+                    f"{r['threshold']} after {r['fires']} fire(s)",
+                )
+            elif r["fires"]:
+                finding(
+                    "slo_alert",
+                    f"rule '{name}' ({r['kind']}) fired {r['fires']} "
+                    f"time(s), cleared before the stream ended",
+                )
 
     # -- classification (most-specific first) --------------------------------
     bundle_reason = (
@@ -432,6 +474,7 @@ def analyze(evidence, *, recompile_storm_threshold=DEFAULT_RECOMPILE_STORM):
             "collective_hangs": len(coll_spans) + n_wait_timeouts,
             "topology_rejections": n_topology,
             "interrupt_history": interrupt_history,
+            "slo_alerts": slo_alerts,
             "last_status": (summary or {}).get("status"),
         },
     }

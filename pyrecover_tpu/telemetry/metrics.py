@@ -28,6 +28,14 @@ Wired-in histograms (see the train/loader/checkpoint/retry call sites):
 for call sites inside the training loop. With no sink registered a flush
 is a no-op (the registry still accumulates — tests and bench read it
 directly via ``snapshot()``).
+
+The grid is the fleet-merge contract: every process buckets on the SAME
+geometric grid, so the live-metrics plane (``telemetry/exporter.py`` /
+``telemetry/aggregate.py``) merges histograms bucket-wise EXACTLY —
+``snapshot(raw_buckets=True)`` carries the JSON-safe bucket counts
+(``"zero"`` for the zero bucket, ``str(idx)`` otherwise) and
+``percentile_from_buckets`` recomputes percentiles over any bucket-wise
+sum with the same error bound as a single process.
 """
 
 import math
@@ -107,22 +115,24 @@ class Histogram:
     def percentile(self, q):  # jaxlint: host-only
         """Estimated q-quantile (0 < q <= 1): the geometric midpoint of the
         bucket the quantile rank falls in, clamped to observed min/max."""
-        if self.count == 0:
-            return None
-        rank = q * self.count
-        items = sorted(
-            self.buckets.items(), key=lambda kv: (kv[0] is not None, kv[0] or 0)
-        )
-        cum = 0
-        for idx, n in items:
-            cum += n
-            if cum >= rank - 1e-9:
-                if idx is None:
-                    return 0.0
-                lo, hi = _BASE ** (idx - 1), _BASE ** idx
-                est = math.sqrt(lo * hi)
-                return min(max(est, self.min), self.max)
-        return self.max
+        with _lock:
+            buckets = dict(self.buckets)
+            count, vmin, vmax = self.count, self.min, self.max
+        return percentile_from_buckets(buckets, count, vmin, vmax, q)
+
+    def raw(self):  # jaxlint: host-only
+        """JSON-safe exact state: count/sum/min/max plus the bucket counts
+        keyed by :func:`bucket_key` — the exposition/merge wire format."""
+        with _lock:
+            buckets = dict(self.buckets)
+            d = {
+                "count": self.count,
+                "sum": round(self.sum, 9),
+                "min": self.min,
+                "max": self.max,
+            }
+        d["buckets"] = {bucket_key(idx): n for idx, n in buckets.items()}
+        return d
 
     def as_dict(self):  # jaxlint: host-only
         d = {
@@ -135,6 +145,53 @@ class Histogram:
             p = self.percentile(q)
             d[label] = round(p, 6) if p is not None else None
         return d
+
+
+def bucket_key(idx):  # jaxlint: host-only
+    """JSON-safe bucket label: ``"zero"`` for the zero bucket (idx None),
+    else the decimal bucket index (may be negative)."""
+    return "zero" if idx is None else str(idx)
+
+
+def bucket_from_key(key):  # jaxlint: host-only
+    """Inverse of :func:`bucket_key`."""
+    return None if key == "zero" else int(key)
+
+
+def bucket_bounds(idx):  # jaxlint: host-only
+    """``(lo, hi]`` value range of bucket ``idx`` (the zero bucket is
+    ``(None, 0.0]``)."""
+    if idx is None:
+        return None, 0.0
+    return _BASE ** (idx - 1), _BASE ** idx
+
+
+def percentile_from_buckets(buckets, count, vmin, vmax, q):  # jaxlint: host-only
+    """Estimated q-quantile over any log-bucket count dict on THE grid —
+    a single histogram's or a fleet-level bucket-wise sum's. ``buckets``
+    is keyed by bucket index (None = zero bucket); the estimate is the
+    geometric midpoint of the bucket the rank falls in, clamped to the
+    observed min/max when known."""
+    if count <= 0:
+        return None
+    rank = q * count
+    items = sorted(
+        buckets.items(), key=lambda kv: (kv[0] is not None, kv[0] or 0)
+    )
+    cum = 0
+    for idx, n in items:
+        cum += n
+        if cum >= rank - 1e-9:
+            if idx is None:
+                return 0.0
+            lo, hi = bucket_bounds(idx)
+            est = math.sqrt(lo * hi)
+            if vmin is not None:
+                est = max(est, vmin)
+            if vmax is not None:
+                est = min(est, vmax)
+            return est
+    return vmax
 
 
 def counter(name):  # jaxlint: host-only
@@ -162,8 +219,12 @@ def histogram(name):  # jaxlint: host-only
     return h
 
 
-def snapshot():  # jaxlint: host-only
-    """Point-in-time view of every registered metric (plain dicts)."""
+def snapshot(raw_buckets=False):  # jaxlint: host-only
+    """Point-in-time view of every registered metric (plain dicts).
+    ``raw_buckets=True`` adds the exact JSON-safe bucket counts to every
+    histogram entry — the exposition/merge wire format the live-metrics
+    plane scrapes; the default keeps the ``metrics_snapshot`` event
+    schema (percentile summaries only)."""
     with _lock:
         counters = {name: c.value for name, c in _counters.items()}
         gauges = {
@@ -171,7 +232,13 @@ def snapshot():  # jaxlint: host-only
             if g.value is not None
         }
         hist_objs = list(_histograms.items())
-    hists = {name: h.as_dict() for name, h in hist_objs if h.count}
+    hists = {}
+    for name, h in hist_objs:
+        if not h.count:
+            continue
+        hists[name] = h.as_dict()
+        if raw_buckets:
+            hists[name]["buckets"] = h.raw()["buckets"]
     return {"counters": counters, "gauges": gauges, "hists": hists}
 
 

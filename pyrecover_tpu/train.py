@@ -690,6 +690,17 @@ def train(config: TrainConfig):
             # silent-creep-toward-OOM detector's run-level verdict
             **detectors.hbm_run_summary(),
         )
+        exporter = status.pop("exporter", None)
+        if exporter is not None:
+            try:
+                exporter.stop()
+            except Exception as e:
+                # teardown must not mask the run's own exit path; a
+                # wedged exporter thread is daemonic and dies with us
+                log_host0(
+                    "metrics exporter did not stop cleanly: %s", e,
+                    level=30,  # WARNING
+                )
         for sink in owned_sinks:
             telemetry.remove_sink(sink)
         telemetry.flight.uninstall()
@@ -807,6 +818,13 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
             telemetry.JsonlSink(telemetry_path, append=resume_requested)))
     if config.telemetry_stdout:
         owned_sinks.append(telemetry.add_sink(telemetry.LogSink()))
+    # live-metrics endpoint ($PYRECOVER_METRICS_PORT): the per-process
+    # exposition half of the live telemetry plane — started after the
+    # sinks so exporter_started lands in the stream, stopped (bounded
+    # join) on train()'s unwind
+    from pyrecover_tpu.telemetry.exporter import maybe_start_from_env
+
+    status["exporter"] = maybe_start_from_env()
     telemetry.emit(
         "run_start",
         devices=jax.device_count(),
@@ -1316,7 +1334,21 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
                         iter_s=round(dt / n, 6), sync_s=round(sync_s, 6),
                         grad_accum_steps=config.grad_accumulation_steps,
                     )
+                    # live plane: the same derived numbers the throughput
+                    # event carries, as gauges the exporter can serve
+                    # between flushes (dict writes — no sync, no I/O)
+                    telemetry.metrics.gauge("train_step").set(step)
                     if snap is not None:
+                        for key, gauge_name in (
+                            ("tokens_per_sec", "train_tokens_per_sec"),
+                            ("mfu_pct", "train_mfu_pct"),
+                            ("tflops", "train_tflops"),
+                        ):
+                            v = snap.get(key)
+                            if isinstance(v, (int, float)):
+                                telemetry.metrics.gauge(gauge_name).set(
+                                    round(v, 4)
+                                )
                         telemetry.emit(
                             "throughput", step=step,
                             **{

@@ -1,0 +1,560 @@
+"""Live telemetry plane (telemetry/exporter.py + aggregate.py + tools/top.py).
+
+The contract under test: the per-process HTTP exposition endpoint serves
+the metrics registry in Prometheus text + raw-bucket JSON and stops with
+a bounded join; the SLO burn-rate evaluator measures interval deltas
+(never the whole cumulative run) and emits ``slo_alert`` only on
+fire/clear transitions; the fleet aggregator merges histograms
+bucket-wise exactly on the shared grid, sums counters with restart
+detection (a restart never renders as a negative rate), and flags stale
+targets instead of dropping them; and the summarizer/doctor read the
+alert trail back out.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from pyrecover_tpu import telemetry
+from pyrecover_tpu.telemetry import metrics
+from pyrecover_tpu.telemetry.aggregate import (
+    FleetAggregator,
+    _Target,
+    fleet_drill,
+    merge_raw_hists,
+    normalize_target,
+    scrape,
+)
+from pyrecover_tpu.telemetry.exporter import (
+    DEFAULT_RULES,
+    PORT_ENV,
+    RULES_ENV,
+    AlertRule,
+    MetricsExporter,
+    _AlertEvaluator,
+    _DeltaTracker,
+    default_alert_rules,
+    maybe_start_from_env,
+    parse_alert_rules,
+    render_prometheus,
+)
+from pyrecover_tpu.telemetry.metrics import percentile_from_buckets
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture()
+def mem_sink():
+    sink = telemetry.MemorySink()
+    telemetry.add_sink(sink)
+    metrics.reset()
+    yield sink
+    telemetry.remove_sink(sink)
+    metrics.reset()
+
+
+def _events(sink, name):
+    return [e for e in sink.events if e["event"] == name]
+
+
+# ---- rule parsing -----------------------------------------------------------
+
+
+def test_parse_alert_rules_syntax():
+    rules = parse_alert_rules(
+        "request_p99>1.5,step_regress>2@60, backpressure_duty>0.25@5"
+    )
+    assert [r.kind for r in rules] == [
+        "request_p99", "step_regress", "backpressure_duty",
+    ]
+    assert rules[0].threshold == 1.5 and rules[0].window_s == 30.0
+    assert rules[0].series == "e2e_s"
+    assert rules[1].window_s == 60.0
+    assert rules[1].series == "step_iter_s"
+    assert rules[2].threshold == 0.25 and rules[2].window_s == 5.0
+    assert rules[2].series == "serving_backpressure_total"
+    assert parse_alert_rules("") == []
+    assert parse_alert_rules(None) == []
+    with pytest.raises(ValueError, match="kind>threshold"):
+        parse_alert_rules("request_p99=1.5")
+    with pytest.raises(ValueError, match="unknown alert rule kind"):
+        parse_alert_rules("bogus>1")
+
+
+def test_default_rules_follow_env(monkeypatch):
+    monkeypatch.delenv(RULES_ENV, raising=False)
+    assert [r.kind for r in default_alert_rules()] == [
+        r.kind for r in parse_alert_rules(DEFAULT_RULES)
+    ]
+    monkeypatch.setenv(RULES_ENV, "request_p99>9.5@7")
+    (rule,) = default_alert_rules()
+    assert rule.threshold == 9.5 and rule.window_s == 7.0
+
+
+# ---- interval deltas --------------------------------------------------------
+
+
+def test_delta_tracker_interval_deltas():
+    t = _DeltaTracker()
+    # first sample: the whole cumulative state IS the first interval
+    delta, n = t.feed({"count": 3, "buckets": {"0": 1, "4": 2}})
+    assert n == 3 and delta == {0: 1, 4: 2}
+    # nothing new -> nothing to measure (hold state, don't re-alert)
+    delta, n = t.feed({"count": 3, "buckets": {"0": 1, "4": 2}})
+    assert n == 0 and delta is None
+    # growth -> only the new observations
+    delta, n = t.feed({"count": 5, "buckets": {"0": 1, "4": 3, "9": 1}})
+    assert n == 2 and delta == {4: 1, 9: 1}
+    # count going BACKWARDS (registry reset) re-baselines, never negative
+    delta, n = t.feed({"count": 1, "buckets": {"2": 1}})
+    assert n == 1 and delta == {2: 1}
+    assert t.feed(None) == (None, 0)
+
+
+def test_percentile_from_buckets_matches_histogram(mem_sink):
+    h = metrics.histogram("t_lat_s")
+    values = [0.001, 0.004, 0.01, 0.01, 0.05, 0.2, 0.2, 1.5, 4.0]
+    for v in values:
+        h.observe(v)
+    raw = h.raw()
+    buckets = {
+        None if k == "zero" else int(k): n
+        for k, n in raw["buckets"].items()
+    }
+    for q in (0.5, 0.95, 0.99):
+        assert percentile_from_buckets(
+            buckets, raw["count"], raw["min"], raw["max"], q
+        ) == pytest.approx(h.percentile(q))
+
+
+# ---- Prometheus exposition --------------------------------------------------
+
+
+def test_render_prometheus_format(mem_sink):
+    metrics.counter("reqs_total").inc(7)
+    metrics.gauge("occupancy_pct").set(42.5)
+    h = metrics.histogram("lat_s")
+    for v in (0.01, 0.02, 0.5):
+        h.observe(v)
+    text = render_prometheus(metrics.snapshot(raw_buckets=True))
+    assert "# TYPE pyrecover_reqs_total counter" in text
+    assert "pyrecover_reqs_total 7" in text
+    assert "pyrecover_occupancy_pct 42.5" in text
+    assert "# TYPE pyrecover_lat_s histogram" in text
+    # cumulative buckets, terminated by +Inf == count
+    assert 'pyrecover_lat_s_bucket{le="+Inf"} 3' in text
+    assert "pyrecover_lat_s_count 3" in text
+    assert "pyrecover_lat_s_sum 0.53" in text
+    bucket_counts = [
+        int(line.rsplit(" ", 1)[1])
+        for line in text.splitlines()
+        if line.startswith("pyrecover_lat_s_bucket")
+    ]
+    assert bucket_counts == sorted(bucket_counts), "buckets not cumulative"
+
+
+# ---- the HTTP endpoint ------------------------------------------------------
+
+
+def test_exporter_roundtrip_and_bounded_stop(mem_sink):
+    metrics.counter("served_total").inc(11)
+    metrics.histogram("e2e_s").observe(0.25)
+    exporter = MetricsExporter(port=0).start()
+    try:
+        assert exporter.port != 0
+        with urllib.request.urlopen(
+            f"{exporter.url}/metrics", timeout=5
+        ) as resp:
+            body = resp.read().decode()
+            assert resp.headers["Content-Type"].startswith("text/plain")
+        assert "pyrecover_served_total 11" in body
+        assert "pyrecover_e2e_s_count 1" in body
+
+        snap = scrape(f"127.0.0.1:{exporter.port}", timeout_s=5)
+        assert snap["counters"]["served_total"] == 11
+        assert snap["hists"]["e2e_s"]["count"] == 1
+        assert snap["hists"]["e2e_s"]["buckets"], "raw buckets missing"
+        assert snap["pid"] and snap["start_ts"] and snap["seq"] >= 1
+
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"{exporter.url}/nope", timeout=5)
+        assert err.value.code == 404
+    finally:
+        exporter.stop()
+    assert not exporter._thread
+    (started,) = _events(mem_sink, "exporter_started")
+    assert started["port"] == exporter.port
+    (stopped,) = _events(mem_sink, "exporter_stopped")
+    assert stopped["scrapes"] >= 2 and stopped["uptime_s"] >= 0
+
+
+def test_maybe_start_from_env(mem_sink, monkeypatch):
+    monkeypatch.delenv(PORT_ENV, raising=False)
+    assert maybe_start_from_env() is None
+    monkeypatch.setenv(PORT_ENV, "0")
+    exporter = maybe_start_from_env()
+    try:
+        assert exporter is not None and exporter.port != 0
+        assert scrape(f"127.0.0.1:{exporter.port}")["seq"] >= 1
+    finally:
+        exporter.stop()
+
+
+# ---- the SLO rule engine ----------------------------------------------------
+
+
+def test_request_p99_fires_and_clears(mem_sink):
+    ev = _AlertEvaluator([AlertRule("request_p99", 0.1, window_s=60.0)])
+    h = metrics.histogram("e2e_s")
+    h.observe(0.5)  # breach
+    fired = ev.evaluate(metrics.snapshot(raw_buckets=True), now=100.0)
+    assert [(r.name, s) for r, s, _ in fired] == [
+        ("request_p99", "firing")
+    ]
+    # only NEW observations count: a window of fast requests clears the
+    # alert even though the cumulative p99 is still slow
+    for _ in range(50):
+        h.observe(0.01)
+    fired = ev.evaluate(metrics.snapshot(raw_buckets=True), now=101.0)
+    assert [(r.name, s) for r, s, _ in fired] == [
+        ("request_p99", "cleared")
+    ]
+    # no new samples: hold state silently
+    assert ev.evaluate(metrics.snapshot(raw_buckets=True), now=102.0) == []
+    states = ev.states()
+    assert states["request_p99"]["state"] == "ok"
+    assert states["request_p99"]["fires"] == 1
+    assert metrics.counter("slo_alerts_total").value == 1
+    events = _events(mem_sink, "slo_alert")
+    assert [e["state"] for e in events] == ["firing", "cleared"]
+    assert events[0]["rule"] == "request_p99"
+    assert events[0]["value"] > 0.1
+    assert events[0]["threshold"] == 0.1 and events[0]["series"] == "e2e_s"
+
+
+def test_step_regress_needs_baseline_then_fires(mem_sink):
+    ev = _AlertEvaluator([AlertRule("step_regress", 2.0, window_s=60.0)])
+    h = metrics.histogram("step_iter_s")
+    # 4 steady windows build the EWMA baseline without judging themselves
+    for i in range(4):
+        for _ in range(5):
+            h.observe(0.01)
+        assert ev.evaluate(
+            metrics.snapshot(raw_buckets=True), now=100.0 + i
+        ) == []
+    # a 10x-slower window against the steady baseline: regression
+    for _ in range(5):
+        h.observe(0.1)
+    fired = ev.evaluate(metrics.snapshot(raw_buckets=True), now=105.0)
+    assert [(r.kind, s) for r, s, _ in fired] == [
+        ("step_regress", "firing")
+    ]
+    (_, _, ratio) = fired[0]
+    assert ratio > 2.0
+
+
+def test_backpressure_duty_window(mem_sink):
+    ev = _AlertEvaluator(
+        [AlertRule("backpressure_duty", 0.5, window_s=4.0)]
+    )
+    c = metrics.counter("serving_backpressure_total")
+
+    def snap():
+        return metrics.snapshot(raw_buckets=True)
+
+    assert ev.evaluate(snap(), now=100.0) == []  # first sample: baseline
+    c.inc()
+    fired = ev.evaluate(snap(), now=101.0)  # 1/1 intervals moved -> 1.0
+    assert [(r.kind, s) for r, s, _ in fired] == [
+        ("backpressure_duty", "firing")
+    ]
+    # the breach ages out of the window as quiet intervals accumulate
+    cleared = []
+    for i in range(2, 8):
+        cleared += ev.evaluate(snap(), now=100.0 + i)
+    assert [(r.kind, s) for r, s, _ in cleared] == [
+        ("backpressure_duty", "cleared")
+    ]
+
+
+# ---- fleet merge semantics --------------------------------------------------
+
+
+def test_merge_raw_hists_bucketwise_exact(mem_sink):
+    a = metrics.histogram("part_a_s")
+    b = metrics.histogram("part_b_s")
+    ref = metrics.histogram("ref_s")
+    va = [0.01, 0.05, 0.2, 1.5]
+    vb = [0.03, 0.08, 0.8, 4.0, 4.0]
+    for v in va:
+        a.observe(v)
+        ref.observe(v)
+    for v in vb:
+        b.observe(v)
+        ref.observe(v)
+    merged = merge_raw_hists([a.raw(), b.raw()])
+    want = ref.raw()
+    assert merged["buckets"] == want["buckets"]
+    assert merged["count"] == want["count"] == len(va) + len(vb)
+    assert merged["sum"] == pytest.approx(want["sum"])
+    assert merged["min"] == want["min"] and merged["max"] == want["max"]
+    for q, label in ((0.5, "p50"), (0.99, "p99")):
+        assert merged[label] == pytest.approx(
+            ref.percentile(q), abs=1e-6
+        )
+    assert merge_raw_hists([None, {}]) is None
+
+
+def test_target_restart_detection_never_negative():
+    tgt = _Target("127.0.0.1:9")
+    lifetime1 = {
+        "pid": 100, "start_ts": 1.0, "seq": 5,
+        "counters": {"reqs_total": 10},
+        "hists": {"lat_s": {"count": 2, "sum": 0.3, "min": 0.1,
+                            "max": 0.2, "buckets": {"0": 2}}},
+        "gauges": {},
+    }
+    tgt.feed(lifetime1, now=100.0)
+    assert tgt.counters() == {"reqs_total": 10}
+    # new pid + counters back at 3: a restart, NOT a -7 rate
+    lifetime2 = dict(lifetime1, pid=200, seq=1,
+                     counters={"reqs_total": 3},
+                     hists={"lat_s": {"count": 1, "sum": 0.1, "min": 0.1,
+                                      "max": 0.1, "buckets": {"0": 1}}})
+    tgt.feed(lifetime2, now=101.0)
+    assert tgt.restarts == 1
+    assert tgt.counters() == {"reqs_total": 13}
+    assert tgt.hists()["lat_s"]["count"] == 3
+    # same identity, counter goes backwards: also a restart signal
+    tgt.feed(dict(lifetime2, counters={"reqs_total": 1}), now=102.0)
+    assert tgt.restarts == 2
+    assert tgt.counters() == {"reqs_total": 14}
+
+
+def test_aggregator_over_real_tcp_flags_stale(mem_sink):
+    metrics.counter("reqs_total").inc(5)
+    metrics.gauge("tokens_per_sec").set(100.0)
+    metrics.histogram("lat_s").observe(0.05)
+    exporter = MetricsExporter(port=0).start()
+    try:
+        # one live endpoint + one that never answers: the dead target is
+        # FLAGGED, and the live one's series still merge
+        agg = FleetAggregator(
+            [f"127.0.0.1:{exporter.port}", "127.0.0.1:1"],
+            stale_after_s=10.0, timeout_s=0.5,
+        )
+        fleet = agg.poll()
+    finally:
+        exporter.stop()
+    assert fleet["n_targets"] == 2 and fleet["n_ok"] == 1
+    assert fleet["stale"] == ["127.0.0.1:1"]
+    dead = fleet["targets"]["127.0.0.1:1"]
+    assert dead["stale"] and dead["error"]
+    assert fleet["counters"]["reqs_total"] == 5
+    assert fleet["gauges"]["tokens_per_sec"]["sum"] == 100.0
+    assert fleet["hists"]["lat_s"]["count"] == 1
+    (scrape_ev,) = _events(mem_sink, "metrics_scrape")
+    assert scrape_ev["targets"] == 2 and scrape_ev["ok"] == 1
+    assert scrape_ev["stale"] == 1
+
+
+@pytest.mark.slow
+def test_fleet_drill_two_real_processes(tmp_path):
+    """The acceptance drill: two genuinely separate exporter processes
+    merged over TCP — exact counter sums, bucket-wise histogram
+    equality — then one SIGKILLed and reported stale (format.sh runs the
+    same drill via ``aggregate --drill``)."""
+    report = fleet_drill(tmp_path)
+    assert report["targets"] == 2
+    assert report["merged_requests_total"] == 12  # 7 + 5, exactly
+    assert report["stale_after_kill"] == [report["killed"]]
+
+
+# ---- top.py -----------------------------------------------------------------
+
+
+def test_top_once_json_and_render(mem_sink, capsys):
+    import top
+
+    metrics.counter("serving_tokens_total").inc(42)
+    metrics.gauge("kv_pool_occupancy_pct").set(31.25)
+    metrics.gauge("serving_tokens_per_sec").set(640.0)
+    metrics.histogram("e2e_s").observe(0.12)
+    metrics.histogram("step_iter_s").observe(0.02)
+    exporter = MetricsExporter(port=0).start()
+    try:
+        target = f"127.0.0.1:{exporter.port}"
+        assert top.main([target, "--once", "--json"]) == 0
+        fleet = json.loads(capsys.readouterr().out)
+        assert fleet["n_ok"] == 1
+        assert fleet["counters"]["serving_tokens_total"] == 42
+
+        assert top.main([target, "--once"]) == 0
+        text = capsys.readouterr().out
+    finally:
+        exporter.stop()
+    assert "ok]" in text and target in text
+    assert "e2e" in text and "step time" in text
+    assert "31.2" in text  # KV occupancy rendered
+
+
+# ---- unwind flushes (the run's LAST word must cover its last work) ----------
+
+
+def test_engine_stop_flushes_registry(mem_sink):
+    import jax
+
+    from pyrecover_tpu.models import ModelConfig, init_params
+    from pyrecover_tpu.serving import ServingConfig, ServingEngine
+
+    cfg = ModelConfig().tiny(
+        max_seq_len=64, vocab_size=64, compute_dtype="float32",
+        param_dtype="float32",
+    )
+    engine = ServingEngine(
+        init_params(jax.random.key(0), cfg), cfg,
+        ServingConfig(block_size=8, max_seqs=2, prefill_chunk=16,
+                      prefill_token_budget=32),
+    )
+    engine.start()
+    try:
+        rid = engine.submit([1, 2, 3], 4)
+        deadline = time.monotonic() + 60.0
+        while engine.pending and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert not engine.pending
+    finally:
+        engine.stop()
+    assert len(engine.result(rid)) == 3 + 4  # prompt + generated
+    snaps = [
+        e for e in _events(mem_sink, "metrics_snapshot")
+        if e.get("reason") == "serving_stop"
+    ]
+    assert snaps, "engine.stop() must flush the registry"
+    # the flushed snapshot covers the very last request served
+    assert snaps[-1]["hists"]["e2e_s"]["count"] == 1
+    assert snaps[-1]["counters"]["serving_tokens_total"] == 4
+
+
+@pytest.mark.slow
+def test_train_run_end_snapshot_covers_last_step(tmp_path, monkeypatch):
+    """Satellite regression: a short run's LAST metrics_snapshot must
+    cover the last step (run-unwind flush), and PYRECOVER_METRICS_PORT
+    must run the exposition endpoint over the whole run (started/stopped
+    trail in the stream)."""
+    monkeypatch.setenv(PORT_ENV, "0")
+    from pyrecover_tpu.config import TrainConfig
+    from pyrecover_tpu.models import ModelConfig
+    from pyrecover_tpu.train import train
+
+    cfg = TrainConfig(
+        sequence_length=32, batch_size=8, training_samples=64,
+        training_steps=4, learning_rate=1e-3, seed=3,
+        checkpoint_dir=str(tmp_path), checkpoint_frequency=3,
+        experiment_name="exp", logging_frequency=2, telemetry=True,
+        async_checkpoint=False,
+    )
+    cfg.model = ModelConfig().tiny(max_seq_len=32, vocab_size=128)
+    cfg.__post_init__()
+    _, end_step, stopped = train(cfg)
+    assert end_step == 4 and not stopped
+
+    evs = telemetry.read_events(tmp_path / "exp" / "exp_telemetry.jsonl")
+    names = {e["event"] for e in evs}
+    assert {"exporter_started", "exporter_stopped"} <= names
+    snaps = [e for e in evs if e["event"] == "metrics_snapshot"]
+    assert snaps and snaps[-1]["reason"] == "run_end"
+    assert snaps[-1]["gauges"]["train_step"] == 4
+    assert snaps[-1]["gauges"]["train_tokens_per_sec"] > 0
+
+
+# ---- summarizer + doctor read the alert trail back --------------------------
+
+
+def _alert_stream(tmp_path):
+    events = [
+        {"event": "run_start", "ts": 100.0, "host": 0},
+        {"event": "slo_alert", "ts": 102.0, "rule": "request_p99",
+         "kind": "request_p99", "state": "firing", "value": 3.5,
+         "threshold": 2.0, "window_s": 30.0, "series": "e2e_s"},
+        {"event": "slo_alert", "ts": 104.0, "rule": "request_p99",
+         "kind": "request_p99", "state": "cleared", "value": 1.1,
+         "threshold": 2.0, "window_s": 30.0, "series": "e2e_s"},
+        {"event": "slo_alert", "ts": 105.0, "rule": "step_regress",
+         "kind": "step_regress", "state": "firing", "value": 2.7,
+         "threshold": 2.0, "window_s": 30.0, "series": "step_iter_s"},
+        {"event": "train_sync", "ts": 110.0, "step": 10, "iter_s": 0.5,
+         "steps": 5, "sync_s": 0.01, "loss": 1.9},
+    ]
+    path = tmp_path / "telemetry.jsonl"
+    path.write_text(
+        "".join(json.dumps(e) + "\n" for e in events)
+    )
+    return path
+
+
+def test_summarizer_slo_alert_section(tmp_path, capsys):
+    from summarize_telemetry import aggregate, render
+
+    agg = aggregate(telemetry.read_events(_alert_stream(tmp_path)))
+    alerts = agg["alerts"]
+    assert alerts["total_fires"] == 2
+    p99 = alerts["rules"]["request_p99"]
+    assert p99["fires"] == 1 and p99["clears"] == 1
+    assert p99["first_fire_s"] == 2.0 and p99["last_fire_s"] == 2.0
+    assert p99["firing_s"] == 2.0 and p99["duty_pct"] == 20.0
+    assert not p99["firing_at_end"]
+    regress = alerts["rules"]["step_regress"]
+    assert regress["firing_at_end"] and regress["firing_s"] == 5.0
+    render(agg)
+    out = capsys.readouterr().out
+    assert "SLO alerts" in out
+    assert "STILL FIRING at stream end" in out
+
+
+def test_doctor_flags_death_under_sustained_alerting(tmp_path):
+    from pyrecover_tpu.telemetry.doctor import diagnose
+
+    report = diagnose(_alert_stream(tmp_path))
+    # the stream dies without a run_summary WHILE step_regress fires
+    assert report["classification"] == "crash"
+    slo = report["evidence"]["slo_alerts"]
+    assert slo["total_fires"] == 2
+    assert slo["rules"]["step_regress"]["firing_at_end"]
+    findings = [
+        f["detail"] for f in report["findings"] if f["kind"] == "slo_alert"
+    ]
+    assert any("FIRING when the run died" in d for d in findings)
+    assert any("cleared before the stream ended" in d for d in findings)
+
+
+# ---- catalog + hygiene pins -------------------------------------------------
+
+
+def test_live_metrics_events_documented_in_both_catalogs():
+    import pyrecover_tpu.telemetry as t
+
+    readme = (REPO / "README.md").read_text()
+    for name in ("exporter_started", "exporter_stopped", "metrics_scrape",
+                 "slo_alert"):
+        assert name in t.__doc__, f"{name} missing from telemetry catalog"
+        assert name in readme, f"{name} missing from README event table"
+    assert "## Live metrics" in readme
+    # cross-links the satellite demands
+    assert "#live-metrics" in readme
+    for env in ("PYRECOVER_METRICS_PORT", "PYRECOVER_SLO_RULES"):
+        assert env in readme, f"{env} undocumented"
+
+
+def test_exporter_url_normalization():
+    assert normalize_target("host:9100") == (
+        "http://host:9100/snapshot.json"
+    )
+    assert normalize_target(":9100") == (
+        "http://127.0.0.1:9100/snapshot.json"
+    )
+    assert normalize_target("http://h:1/") == "http://h:1/snapshot.json"

@@ -478,6 +478,69 @@ def aggregate(events):
         }
     agg["autopilot"] = autopilot
 
+    # SLO alert rollup: the live-metrics exporter's burn-rate rule trail
+    # (``slo_alert`` firing/cleared transitions, README "Live metrics") —
+    # per rule: fire/clear counts, first/last fire offset into the
+    # stream, and the duty cycle (fraction of the stream's span the rule
+    # spent firing; a rule still firing at stream end accrues to the
+    # last event and is flagged)
+    alerts = by.get("slo_alert", [])
+    alert_agg = {}
+    if alerts:
+        ts_all = [
+            e["ts"] for e in events
+            if isinstance(e.get("ts"), (int, float))
+        ]
+        span_start = min(ts_all) if ts_all else 0.0
+        span_end = max(ts_all) if ts_all else 0.0
+        span_s = max(span_end - span_start, 1e-9)
+        rules = {}
+        for e in alerts:
+            r = rules.setdefault(e.get("rule", "?"), {
+                "kind": e.get("kind"),
+                "threshold": e.get("threshold"),
+                "window_s": e.get("window_s"),
+                "fires": 0, "clears": 0,
+                "first_fire_s": None, "last_fire_s": None,
+                "firing_s": 0.0, "firing_at_end": False,
+                "peak_value": None, "_since": None,
+            })
+            ts = e.get("ts")
+            if not isinstance(ts, (int, float)):
+                ts = None
+            if e.get("state") == "firing":
+                r["fires"] += 1
+                rel = round(ts - span_start, 3) if ts is not None else None
+                if r["first_fire_s"] is None:
+                    r["first_fire_s"] = rel
+                r["last_fire_s"] = rel
+                if r["_since"] is None and ts is not None:
+                    r["_since"] = ts
+                v = e.get("value")
+                if isinstance(v, (int, float)) and (
+                    r["peak_value"] is None or v > r["peak_value"]
+                ):
+                    r["peak_value"] = v
+            elif e.get("state") == "cleared":
+                r["clears"] += 1
+                if r["_since"] is not None and ts is not None:
+                    r["firing_s"] += ts - r["_since"]
+                r["_since"] = None
+        for r in rules.values():
+            if r["_since"] is not None:  # still firing at stream end
+                r["firing_s"] += span_end - r["_since"]
+                r["firing_at_end"] = True
+            del r["_since"]
+            r["firing_s"] = round(r["firing_s"], 4)
+            r["duty_pct"] = round(100.0 * r["firing_s"] / span_s, 2)
+        alert_agg = {
+            "events": len(alerts),
+            "total_fires": sum(r["fires"] for r in rules.values()),
+            "span_s": round(span_s, 4),
+            "rules": rules,
+        }
+    agg["alerts"] = alert_agg
+
     agg["warnings"] = [
         f"MFU denominator unknown for device kind {e.get('device_kind')!r}"
         for e in by.get("mfu_peak_unknown", [])
@@ -716,6 +779,25 @@ def render(agg, out=None):
               f"swap window\n")
         for r in hs.get("rejected_reasons", []):
             w(f"  REJECTED           {r['path']}: {r['reason']}\n")
+    al = agg.get("alerts") or {}
+    if al.get("events"):
+        w("\n-- SLO alerts (exporter burn-rate rules) -----------------------\n")
+        w(f"  {al['total_fires']} fire(s) across {len(al['rules'])} "
+          f"rule(s) over a {al['span_s']:.1f}s stream\n")
+        for name, r in sorted(al["rules"].items()):
+            peak = (
+                f", peak {r['peak_value']:.4g} vs threshold "
+                f"{r['threshold']:.4g}"
+                if isinstance(r.get("peak_value"), (int, float))
+                and isinstance(r.get("threshold"), (int, float)) else ""
+            )
+            w(f"  {name:<18} {r['fires']} fire(s) / {r['clears']} "
+              f"clear(s), first @ +{r['first_fire_s']}s, last @ "
+              f"+{r['last_fire_s']}s\n")
+            w(f"  {'':<18} firing {r['firing_s']}s — duty "
+              f"{r['duty_pct']:.1f}%{peak}\n")
+            if r.get("firing_at_end"):
+                w(f"  {'':<18} STILL FIRING at stream end\n")
     ds = agg["data_stalls"]
     if ds["count"]:
         w(f"\n-- data loader: {ds['count']} stall(s), {ds['wait_s']}s waiting "
@@ -765,6 +847,7 @@ def main(argv=None):
                 "autopilot": agg["autopilot"],
                 "serving": agg["serving"],
                 "hotswap": agg["hotswap"],
+                "alerts": agg["alerts"],
                 "data_stalls": agg["data_stalls"],
                 "preempt": agg["preempt"],
             },
