@@ -68,6 +68,19 @@ python tools/concur.py pyrecover_tpu tools bench.py __graft_entry__.py \
 python tools/distcheck.py pyrecover_tpu tools bench.py __graft_entry__.py \
   --strict --json "${DISTCHECK_JSON:-/tmp/distcheck_report.json}" || rc=1
 
+# obscheck: static observability-contract analysis
+# (pyrecover_tpu/analysis/obscheck — pure stdlib, same engine/suppression
+# machinery under the `obscheck:` namespace). Machine-checks the
+# event/metric plane's three-way contract: every literal emit documented
+# in both catalogs (OB01), no phantom catalog rows (OB02), every
+# consumer-read event/field/span actually produced (OB03) — including
+# the declarative doctor.EVENT_DEPS/SPAN_DEPS and exporter.DEFAULT_SERIES
+# tables — catalogs in agreement with each other (OB04), no unconditional
+# emits on the training hot path (OB05), and every consumed metric series
+# registered (OB06). JSON report beside the others (OBSCHECK_JSON).
+python tools/obscheck.py pyrecover_tpu tools bench.py __graft_entry__.py \
+  --strict --json "${OBSCHECK_JSON:-/tmp/obscheck_report.json}" || rc=1
+
 # shardcheck: abstract SPMD preflight (pyrecover_tpu/analysis/shardcheck).
 # Every shipped preset must validate clean — partition-spec divisibility,
 # axis use, replication, collective census — on 1/2/4/8-device virtual

@@ -34,10 +34,12 @@ from pathlib import Path
 
 # The engine serves more than one analyzer: jaxlint (this package's
 # original tenant), concur (analysis/concur — the concurrency-safety
-# analyzer), and distcheck (analysis/distcheck — the multi-host
-# collective-congruence analyzer) share the parsing, suppression, and
-# marker machinery, each under its own comment namespace
-# (``# jaxlint: ...`` / ``# concur: ...`` / ``# distcheck: ...``).
+# analyzer), distcheck (analysis/distcheck — the multi-host
+# collective-congruence analyzer), and obscheck (analysis/obscheck —
+# the observability-contract analyzer) share the parsing, suppression,
+# and marker machinery, each under its own comment namespace
+# (``# jaxlint: ...`` / ``# concur: ...`` / ``# distcheck: ...`` /
+# ``# obscheck: ...``).
 # Directives (disable/disable-next/disable-file) are TOOL-SCOPED: a
 # ModuleInfo parses only its own tool's suppressions, so a jaxlint
 # suppression can never silence a concur or distcheck finding, or vice
@@ -45,12 +47,16 @@ from pathlib import Path
 # — concur's model consumes jaxlint's ``hot-loop``/``host-only``
 # reachability markers, distcheck's model consumes its own
 # ``host-local`` (function returns per-host state) / ``congruent``
-# (function's return agrees across hosts) declarations, and each tool
-# simply ignores the markers it has no meaning for.
+# (function's return agrees across hosts) declarations, obscheck
+# consumes jaxlint's ``hot-loop`` reachability markers plus its own
+# ``once`` marker (function emits at most once per run — a warn-once /
+# once-per-run guard the AST cannot always see), and each tool simply
+# ignores the markers it has no meaning for.
 _MARKERS_BY_TOOL = {
     "jaxlint": r"hot-loop|sync-point|host-only",
     "concur": r"guarded-by=[\w.\-]+",
     "distcheck": r"host-local|congruent",
+    "obscheck": r"once",
 }
 
 _DIRECTIVE_RES = {}

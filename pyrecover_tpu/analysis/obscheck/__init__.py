@@ -1,0 +1,70 @@
+"""obscheck — static observability-contract analysis.
+
+The fifth axis of the analysis space: jaxlint checks JAX *syntax*
+hazards, shardcheck checks SPMD *launch semantics*, concur checks
+*threading semantics*, distcheck checks *control-flow congruence* — and
+obscheck checks the **observability contract**: the property that the
+telemetry plane's producers (the ~100 ``emit()`` sites, span helpers,
+metric registrations), its two hand-maintained catalogs (the
+``telemetry/__init__.py`` docstring and the README event table), and
+its consumers (doctor classification, the summarizer's sections, the
+fleet aggregator and ``tools/top.py`` series, the exporter's SLO alert
+rules) all describe the same stream. Its failure mode is the one no
+runtime test reliably catches: rename an event or drop a field, and no
+exception is raised anywhere — a doctor diagnosis silently becomes
+"healthy", a summarizer section silently goes empty, a dashboard series
+silently flatlines. Production observability is a first-class subsystem
+(TorchTitan, arxiv 2410.06511); a fleet cannot be debugged from a
+stream whose three corners disagree.
+
+The analyzer reuses the shared engine end to end: the same
+:class:`~pyrecover_tpu.analysis.engine.ModuleInfo` parsing, the same
+cross-module call graph (OB05 walks jaxlint's ``hot-loop`` hot set —
+the cross-tool marker channel concur already consumes), the same
+suppression syntax under the ``obscheck:`` comment namespace
+(tool-scoped: a jaxlint/concur/distcheck disable can never silence an
+OB finding, nor the reverse), and the same text/JSON reporters.
+``model.py`` extracts the observability model — every emit site with
+its literal name and kwarg field set (``**{...}`` dict spreads folded
+in), span sites, metric registrations (aliases, tuple-literal loops and
+f-string wildcard families included), both catalogs, and every consumer
+read, including the declarative ``EVENT_DEPS`` / ``SPAN_DEPS`` /
+``DEFAULT_SERIES`` contract tables in ``telemetry/doctor.py`` and
+``telemetry/exporter.py``.
+
+The rule catalog (``rules.py``): OB01 unknown-event, OB02
+phantom-catalog-entry, OB03 consumer-field-drift, OB04
+catalog-divergence, OB05 hot-path-emit, OB06 metric-name-drift.
+
+Function markers steer the model (parsed cross-tool like jaxlint's)::
+
+    def warn_once(...):   # obscheck: once   <- emits at most once per run
+
+Suppressions carry jaxlint's exact shape under the ``obscheck:``
+namespace, and the test suite rejects justification-free ones::
+
+    if rec.get("event") == "serving":  # obscheck: disable=consumer-field-drift -- why
+
+CLI: ``tools/obscheck.py`` (console script ``obscheck``), gated in
+``format.sh`` with ``--strict`` over the whole repo; ``--list-events``
+dumps the machine-readable model.
+"""
+
+from pyrecover_tpu.analysis.obscheck.model import ObsConfig, ObsModel
+from pyrecover_tpu.analysis.obscheck.rules import (
+    OB_RULES,
+    analyze_modules,
+    analyze_paths,
+    analyze_source,
+    build_model,
+)
+
+__all__ = [
+    "OB_RULES",
+    "ObsConfig",
+    "ObsModel",
+    "analyze_modules",
+    "analyze_paths",
+    "analyze_source",
+    "build_model",
+]

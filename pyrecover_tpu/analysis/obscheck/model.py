@@ -1,0 +1,927 @@
+"""Observability model: producers, catalogs, consumers — extracted once.
+
+Everything the OB rule catalog consumes is computed here from the same
+:class:`~pyrecover_tpu.analysis.engine.ModuleInfo` parse the other four
+analyzers use. The model has three corners (the "observability
+triangle"):
+
+* **Producers** — every ``emit()`` call with a literal event name
+  (``telemetry.emit(...)`` / ``bus.emit(...)`` / a bare ``emit(...)``
+  from-imported from the bus), its keyword field set (fields threaded
+  via ``**{...}`` dict literals are folded in; an opaque ``**kwargs``
+  marks the site's field set *open* so field rules never false-fire),
+  whether the site is conditionally guarded (any enclosing ``if`` /
+  ternary / ``try``), every span site (``span()`` / ``begin()`` /
+  ``record_span()`` with a literal name, plus the ``collective_wait``
+  span ``collective_phase`` opens), and every metric registration —
+  literal ``counter/gauge/histogram("name")`` calls, simple local
+  aliases (``g = metrics.gauge; g("...")``), names drawn from a
+  tuple-literal loop (the ``for key, gauge_name in ((...), ...)``
+  idiom), f-string registrations (compiled to wildcard patterns, e.g.
+  the per-engine ``ckpt_<engine>_<phase>_s`` family), and the
+  ``metric=`` keyword that makes span helpers feed a histogram.
+* **Catalogs** — the structured docstring in
+  ``pyrecover_tpu/telemetry/__init__.py`` (recognized *by content*: any
+  scanned module whose docstring carries the "Core event names" sentinel
+  line — so fixtures can ship their own catalog) and the README event
+  table (auto-discovered next to the catalog module, or injected via
+  :attr:`ObsConfig.readme_text`). Both parsers classify each entry's
+  field list as *closed* (every token is a plain identifier — README
+  fields must be backticked) or *open* (elisions ``...``, optional
+  ``[...]`` groups, prose, ``a/b`` alternations): only closed∧closed
+  pairs are field-compared, so abridged prose rows never drown the
+  signal.
+* **Consumers** — every read of the stream: ``x.get("event") == "lit"``
+  comparisons (and ``in (...)`` tuples), event-keyed mappings (a name
+  ever subscripted with ``e["event"]`` — the summarizer's ``by`` dict —
+  makes ``by.get("lit")`` an event read), field reads on variables bound
+  by iterating such a list (``for e in by.get("x"): e.get("f")``),
+  metric-series reads (``hists.get("lit")`` / ``fleet["counters"]["lit"]``
+  / ``"lit" in hists`` / ``_gauge(fleet, "lit")``), and three
+  *declarative* contract tables parsed as dict/tuple literals wherever
+  they are assigned: ``EVENT_DEPS`` (event → fields the doctor
+  classifier reads), ``SPAN_DEPS`` (span names), ``DEFAULT_SERIES``
+  (alert-kind → metric series, ``telemetry/exporter.py``).
+
+Cross-surface rules (OB01–OB04, OB06) arm only when the docstring
+catalog module is in the scanned set — the proxy for "the whole project
+was scanned" — so pointing the CLI at one stray file checks only its
+local properties instead of declaring every emit unknown.
+"""
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+
+from pyrecover_tpu.analysis.callgraph import (
+    ProjectIndex,
+    build_hot_set,
+    dotted_name,
+)
+from pyrecover_tpu.analysis.engine import DEFAULT_CONFIG
+
+# every record carries these regardless of emit kwargs (bus envelope)
+ENVELOPE_FIELDS = frozenset({"ts", "event", "host"})
+
+# content sentinel that marks a module docstring as THE event catalog
+DOC_SENTINEL = "Core event names across the stack"
+
+# README event-table header (exact row match, pipes normalized)
+README_HEADER = ("event", "fields", "emitted by")
+
+# declarative consumer tables the extractor recognizes by name
+EVENT_DEPS_NAME = "EVENT_DEPS"
+SPAN_DEPS_NAME = "SPAN_DEPS"
+DEFAULT_SERIES_NAME = "DEFAULT_SERIES"
+
+_IDENT = re.compile(r"^[a-z_][a-z0-9_]*$")
+_DOC_ENTRY = re.compile(
+    r"^    ([a-z_][a-z0-9_]*(?:\s*/\s*[a-z_][a-z0-9_]*)*)(?:\s+(.*))?$"
+)
+_BACKTICK = re.compile(r"`([^`]+)`")
+
+
+@dataclasses.dataclass
+class ObsConfig:
+    """Rule selection + catalog injection for the contract analysis."""
+
+    select: frozenset = None
+    ignore: frozenset = frozenset()
+    # README event table injected directly (fixtures); None = discover
+    # README.md three levels above the docstring-catalog module
+    readme_text: str = None
+    # the jaxlint LintConfig supplying hot seeds + the fuzzy-method
+    # blacklist for call resolution (OB05 walks jaxlint's hot set)
+    lint: object = dataclasses.field(default_factory=lambda: DEFAULT_CONFIG)
+
+    def rule_enabled(self, name, rule_id):
+        if name in self.ignore or rule_id in self.ignore:
+            return False
+        if self.select is None:
+            return True
+        return name in self.select or rule_id in self.select
+
+
+DEFAULT_OBS_CONFIG = ObsConfig()
+
+
+@dataclasses.dataclass
+class CatalogEntry:
+    name: str
+    fields: frozenset
+    open: bool  # elided / prose / optional groups — never field-compared
+    path: str
+    line: int
+
+
+@dataclasses.dataclass
+class EmitSite:
+    event: str  # None for a dynamic (non-literal) event name
+    fields: frozenset
+    open: bool  # an opaque ** spread — field set not statically known
+    module: object
+    node: object
+    guarded: bool  # under any if/ternary/try in its function
+
+
+@dataclasses.dataclass
+class SpanSite:
+    name: str
+    module: object
+    node: object
+
+
+@dataclasses.dataclass
+class MetricReg:
+    name: str  # literal series name, or regex source when wildcard
+    kind: str  # counter | gauge | histogram
+    wildcard: bool
+    module: object
+    node: object
+
+
+@dataclasses.dataclass
+class EventRead:
+    event: str
+    field: str  # None = the consumer only dispatches on the name
+    module: object
+    node: object
+
+
+@dataclasses.dataclass
+class SeriesRead:
+    name: str
+    module: object
+    node: object
+
+
+@dataclasses.dataclass
+class SpanRead:
+    name: str
+    module: object
+    node: object
+
+
+def _last_component(call):
+    d = dotted_name(call.func)
+    if d is not None:
+        return d.rsplit(".", 1)[-1]
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return None
+
+
+def _base_last_segment(func):
+    """For ``a.b.emit`` → ``b``; for bare ``emit`` → None."""
+    if not isinstance(func, ast.Attribute):
+        return None
+    d = dotted_name(func.value)
+    if d is not None:
+        return d.rsplit(".", 1)[-1]
+    if isinstance(func.value, ast.Attribute):
+        return func.value.attr
+    return None
+
+
+def _str_const(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _strip_groups(text, open_ch, close_ch):
+    """Remove balanced ``(...)`` / ``{...}`` groups (nested ok)."""
+    out, depth = [], 0
+    for ch in text:
+        if ch == open_ch:
+            depth += 1
+        elif ch == close_ch and depth:
+            depth -= 1
+        elif depth == 0:
+            out.append(ch)
+    return "".join(out)
+
+
+# ---- catalog parsers --------------------------------------------------------
+
+
+def _parse_field_text(text):
+    """(fields, open) from one catalog entry's field prose."""
+    is_open = not text.strip()
+    if "[" in text or "]" in text:
+        is_open = True
+        text = text.replace("[", " ").replace("]", " ")
+    text = _strip_groups(_strip_groups(text, "(", ")"), "{", "}")
+    fields = set()
+    for tok in text.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if _IDENT.match(tok):
+            fields.add(tok)
+        else:
+            is_open = True  # "...", "a/b", "+ X.as_dict()", prose…
+    return frozenset(fields), is_open
+
+
+def parse_docstring_catalog(module):
+    """The structured event catalog in the telemetry package docstring.
+
+    Entry lines sit at exactly 4-space indent after the sentinel line
+    (``name  field, field, ...``; names may be ``/``-joined);
+    deeper-indented lines continue the previous entry; a ``;``-chunk of
+    the form ``other_name: fields`` declares a sibling event (the
+    ``resume ...; resume_replay: replayed_steps`` line)."""
+    doc = ast.get_docstring(module.tree, clean=False)
+    if doc is None or DOC_SENTINEL not in doc:
+        return None
+    base_line = module.tree.body[0].lineno if module.tree.body else 1
+    entries = []  # (names, [field text parts], line)
+    armed = False
+    for i, raw in enumerate(doc.split("\n")):
+        line_no = base_line + i
+        if DOC_SENTINEL in raw:
+            armed = True
+            continue
+        if not armed:
+            continue
+        m = _DOC_ENTRY.match(raw)
+        if m:
+            names = [n.strip() for n in m.group(1).split("/")]
+            entries.append((names, [m.group(2) or ""], line_no))
+        elif raw.startswith("     ") and raw.strip() and entries:
+            entries[-1][1].append(raw.strip())
+    catalog = {}
+    for names, parts, line_no in entries:
+        text = " ".join(parts)
+        chunks = _strip_groups(
+            _strip_groups(text, "(", ")"), "{", "}"
+        ).split(";")
+        extra = []
+        for chunk in chunks[1:]:
+            cm = re.match(r"^\s*([a-z_][a-z0-9_]*)\s*:\s*(.*)$", chunk)
+            if cm:
+                extra.append((cm.group(1), cm.group(2)))
+        primary = chunks[0]
+        # a prose label before a colon ("retroactive span: name, ...")
+        if ":" in primary:
+            primary = primary.rsplit(":", 1)[1]
+        fields, is_open = _parse_field_text(primary)
+        if len(parts) > 1 and not fields:
+            # continuation lines whose parses collapsed — stay open
+            is_open = True
+        for name in names:
+            catalog[name] = CatalogEntry(
+                name, fields, is_open or len(names) > 1,
+                module.relpath, line_no,
+            )
+        for name, ftext in extra:
+            f2, o2 = _parse_field_text(ftext)
+            catalog[name] = CatalogEntry(
+                name, f2, o2, module.relpath, line_no
+            )
+    return catalog
+
+
+def parse_readme_catalog(text, path="README.md"):
+    """The README event table: rows under ``| event | fields | emitted
+    by |``. Event cells contribute every backticked identifier; field
+    cells are read up to the first em-dash, parentheticals stripped —
+    *closed* only when nothing but backticked identifiers, commas and
+    slashes remain (prose rows are open and never field-compared)."""
+    catalog = {}
+    in_table = False
+    for line_no, raw in enumerate(text.split("\n"), start=1):
+        # an escaped \| (a literal pipe inside a cell) is not a divider
+        cells = [
+            c.replace("\x00", "|").strip()
+            for c in raw.replace("\\|", "\x00").strip().strip("|").split("|")
+        ]
+        if tuple(c.lower() for c in cells) == README_HEADER:
+            in_table = True
+            continue
+        if not in_table:
+            continue
+        if not raw.strip().startswith("|"):
+            in_table = False
+            continue
+        if len(cells) < 2 or set(cells[0]) <= {"-", " "}:
+            continue
+        names = [
+            t for t in _BACKTICK.findall(cells[0]) if _IDENT.match(t)
+        ]
+        if not names:
+            continue
+        prefix = cells[1].split("—")[0]
+        prefix = _strip_groups(prefix, "(", ")")
+        fields = frozenset(
+            t for t in _BACKTICK.findall(prefix) if _IDENT.match(t)
+        )
+        residue = _BACKTICK.sub("", prefix)
+        is_open = (
+            "..." in prefix
+            or not fields
+            or bool(residue.replace(",", " ").replace("/", " ").split())
+        )
+        for name in names:
+            catalog[name] = CatalogEntry(
+                name, fields, is_open or len(names) > 1, path, line_no
+            )
+    return catalog or None
+
+
+# ---- per-module extraction --------------------------------------------------
+
+
+class _ModuleScan:
+    """One walk over a module collecting producer + consumer facts."""
+
+    def __init__(self, module, index):
+        self.module = module
+        self.index = index
+        self.emits = []
+        self.spans = []
+        self.metric_regs = []
+        self.event_reads = []
+        self.series_reads = []
+        self.span_reads = []
+        self.dynamic_regs = []
+        self._keyed = self._find_event_keyed_names()
+        self._metric_aliases = self._find_metric_aliases()
+        self._walk_scope(module.tree.body, {})
+        self._scan_declarative_tables()
+
+    # -- pass 1: names ever subscripted with e["event"] (the `by` dict)
+
+    def _is_event_key_expr(self, node):
+        if isinstance(node, ast.Subscript):
+            return _str_const(node.slice) == "event"
+        if isinstance(node, ast.Call) and _last_component(node) == "get":
+            return bool(node.args) and _str_const(node.args[0]) == "event"
+        return False
+
+    def _find_event_keyed_names(self):
+        keyed = set()
+        for node in ast.walk(self.module.tree):
+            if (
+                isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)
+                and self._is_event_key_expr(node.slice)
+            ):
+                keyed.add(node.value.id)
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("setdefault", "get")
+                and isinstance(node.func.value, ast.Name)
+                and node.args
+                and self._is_event_key_expr(node.args[0])
+            ):
+                keyed.add(node.func.value.id)
+        return keyed
+
+    # -- pass 1b: `g = metrics.gauge` style registration aliases
+
+    def _find_metric_aliases(self):
+        aliases = {}
+        for node in ast.walk(self.module.tree):
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                continue
+            src = dotted_name(node.value)
+            if src and src.rsplit(".", 1)[-1] in (
+                "counter", "gauge", "histogram",
+            ):
+                aliases[node.targets[0].id] = src.rsplit(".", 1)[-1]
+        return aliases
+
+    # -- emit recognition
+
+    def _is_emit_call(self, call):
+        if (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr == "emit"
+        ):
+            return _base_last_segment(call.func) in ("telemetry", "bus")
+        if isinstance(call.func, ast.Name) and call.func.id == "emit":
+            imp = self.index.from_imports.get(self.module, {}).get("emit")
+            if imp is not None:
+                src_mod = imp[0] or ""
+                return "telemetry" in src_mod or src_mod.endswith("bus")
+        return False
+
+    def _record_emit(self, call):
+        event = _str_const(call.args[0]) if call.args else None
+        fields, is_open = set(), False
+        for kw in call.keywords:
+            if kw.arg is not None:
+                fields.add(kw.arg)
+            elif isinstance(kw.value, ast.Dict) and all(
+                _str_const(k) is not None for k in kw.value.keys
+            ):
+                fields.update(_str_const(k) for k in kw.value.keys)
+            else:
+                is_open = True
+        guarded = any(
+            isinstance(a, (ast.If, ast.IfExp, ast.Try, ast.While))
+            for a in self.module.ancestors(call)
+        )
+        self.emits.append(
+            EmitSite(
+                event, frozenset(fields), is_open,
+                self.module, call, guarded,
+            )
+        )
+
+    # -- span + metric producers
+
+    def _record_span_or_metric(self, call):
+        last = _last_component(call)
+        if last in ("span", "begin", "record_span", "span_begin"):
+            name = _str_const(call.args[0]) if call.args else None
+            if name is not None:
+                self.spans.append(SpanSite(name, self.module, call))
+        kind = None
+        if last in ("counter", "gauge", "histogram"):
+            kind = last
+        elif isinstance(call.func, ast.Name):
+            kind = self._metric_aliases.get(call.func.id)
+        if kind is not None:
+            self._record_metric_reg(call, call.args[0] if call.args else None,
+                                    kind)
+        for kw in call.keywords:
+            if kw.arg == "metric":
+                self._record_metric_reg(call, kw.value, "histogram")
+
+    def _record_metric_reg(self, call, arg, kind):
+        if arg is None:
+            return
+        lit = _str_const(arg)
+        if lit is not None:
+            self.metric_regs.append(
+                MetricReg(lit, kind, False, self.module, call)
+            )
+            return
+        if isinstance(arg, ast.JoinedStr):
+            parts = []
+            for v in arg.values:
+                if isinstance(v, ast.Constant):
+                    parts.append(re.escape(str(v.value)))
+                else:
+                    parts.append(r".+")
+            self.metric_regs.append(
+                MetricReg("".join(parts), kind, True, self.module, call)
+            )
+            return
+        if isinstance(arg, ast.Name):
+            # the `for key, gauge_name in ((... , "name"), ...)` idiom:
+            # every string constant in the tuple-literal iterable is a
+            # possible registration (over-approximate on purpose)
+            for anc in self.module.ancestors(call):
+                if (
+                    isinstance(anc, ast.For)
+                    and isinstance(anc.iter, (ast.Tuple, ast.List))
+                    and any(
+                        isinstance(n, ast.Name) and n.id == arg.id
+                        for n in ast.walk(anc.target)
+                    )
+                ):
+                    for n in ast.walk(anc.iter):
+                        lit = _str_const(n)
+                        if lit is not None:
+                            self.metric_regs.append(
+                                MetricReg(
+                                    lit, kind, False, self.module, call
+                                )
+                            )
+                    return
+        self.dynamic_regs.append(call)
+
+    # -- consumer reads: scoped walk with event-list / event-item bindings
+
+    def _list_event(self, expr, env):
+        """Event name if ``expr`` evaluates to a list of that event's
+        records: ``by.get("lit", ...)`` / ``by["lit"]`` on an
+        event-keyed name, a bound variable, or reversed/sorted/list()
+        of one."""
+        if isinstance(expr, ast.Name):
+            b = env.get(expr.id)
+            return b[1] if b and b[0] == "list" else None
+        if (
+            isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Name)
+            and expr.func.id in ("reversed", "sorted", "list")
+            and expr.args
+        ):
+            return self._list_event(expr.args[0], env)
+        if (
+            isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Attribute)
+            and expr.func.attr == "get"
+            and isinstance(expr.func.value, ast.Name)
+            and expr.func.value.id in self._keyed
+            and expr.args
+        ):
+            return _str_const(expr.args[0])
+        if (
+            isinstance(expr, ast.Subscript)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id in self._keyed
+        ):
+            return _str_const(expr.slice)
+        return None
+
+    def _item_event(self, expr, env):
+        """Event name if ``expr`` is ONE record of that event: a bound
+        item variable or an index/slice into an event list."""
+        if isinstance(expr, ast.Name):
+            b = env.get(expr.id)
+            return b[1] if b and b[0] == "item" else None
+        if isinstance(expr, ast.Subscript) and _str_const(
+            expr.slice
+        ) is None:
+            return self._list_event(expr.value, env)
+        return None
+
+    def _literals_in(self, node):
+        if _str_const(node) is not None:
+            return [_str_const(node)]
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return [
+                v for v in (_str_const(e) for e in node.elts)
+                if v is not None
+            ]
+        return []
+
+    def _series_receiver(self, expr):
+        """True for ``hists`` / ``counters`` / ``gauges`` names and
+        ``X["hists"]``-style subscripts — the fleet/top read idiom."""
+        if isinstance(expr, ast.Name):
+            return expr.id in ("hists", "counters", "gauges")
+        if isinstance(expr, ast.Subscript):
+            return _str_const(expr.slice) in ("hists", "counters", "gauges")
+        return False
+
+    def _scan_expr(self, node, env):
+        """Consumer-read patterns on one expression node."""
+        # x.get("event") == "lit" / x["event"] in ("a", "b")
+        if isinstance(node, ast.Compare):
+            sides = [node.left] + list(node.comparators)
+            if any(self._is_event_key_expr(s) for s in sides):
+                for s in sides:
+                    for lit in self._literals_in(s):
+                        self.event_reads.append(
+                            EventRead(lit, None, self.module, node)
+                        )
+            if any(self._series_receiver(s) for s in sides):
+                for s in sides:
+                    for lit in self._literals_in(s):
+                        self.series_reads.append(
+                            SeriesRead(lit, self.module, node)
+                        )
+        if isinstance(node, ast.Call):
+            if self._is_emit_call(node):
+                self._record_emit(node)
+            self._record_span_or_metric(node)
+            # _gauge(fleet, "name") — tools/top.py's fleet accessor
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id == "_gauge"
+                and len(node.args) >= 2
+                and _str_const(node.args[1]) is not None
+            ):
+                self.series_reads.append(
+                    SeriesRead(
+                        _str_const(node.args[1]), self.module, node
+                    )
+                )
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and node.args
+            ):
+                recv, key = node.func.value, _str_const(node.args[0])
+                if key is not None:
+                    if (
+                        isinstance(recv, ast.Name)
+                        and recv.id in self._keyed
+                    ):
+                        self.event_reads.append(
+                            EventRead(key, None, self.module, node)
+                        )
+                    elif self._series_receiver(recv):
+                        self.series_reads.append(
+                            SeriesRead(key, self.module, node)
+                        )
+                    else:
+                        ev = self._item_event(recv, env)
+                        if ev is not None and key != "event":
+                            self.event_reads.append(
+                                EventRead(ev, key, self.module, node)
+                            )
+        if isinstance(node, ast.Subscript):
+            key = _str_const(node.slice)
+            if key is not None:
+                if (
+                    isinstance(node.value, ast.Name)
+                    and node.value.id in self._keyed
+                ):
+                    self.event_reads.append(
+                        EventRead(key, None, self.module, node)
+                    )
+                elif self._series_receiver(node.value):
+                    self.series_reads.append(
+                        SeriesRead(key, self.module, node)
+                    )
+                else:
+                    ev = self._item_event(node.value, env)
+                    if ev is not None and key != "event":
+                        self.event_reads.append(
+                            EventRead(ev, key, self.module, node)
+                        )
+
+    def _bind_target(self, target, value, env):
+        if not isinstance(target, ast.Name):
+            return
+        ev = self._list_event(value, env)
+        if ev is not None:
+            env[target.id] = ("list", ev)
+            return
+        ev = self._item_event(value, env)
+        if ev is not None:
+            env[target.id] = ("item", ev)
+            return
+        env.pop(target.id, None)
+
+    def _walk_scope(self, body, env):
+        for stmt in body:
+            self._walk_stmt(stmt, env)
+
+    def _walk_stmt(self, stmt, env):
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node is not stmt:
+                    continue
+            if isinstance(
+                node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                       ast.DictComp)
+            ):
+                self._walk_comp(node, env)
+        # statement-level walk with binding propagation (flow-insensitive
+        # within one body: later statements see earlier bindings)
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            self._scan_subtree(stmt, env)
+            self._bind_target(stmt.targets[0], stmt.value, env)
+        elif isinstance(stmt, ast.For):
+            self._scan_subtree_expr(stmt.iter, env)
+            inner = dict(env)
+            ev = self._list_event(stmt.iter, env)
+            if ev is not None and isinstance(stmt.target, ast.Name):
+                inner[stmt.target.id] = ("item", ev)
+            self._walk_scope(stmt.body, inner)
+            self._walk_scope(stmt.orelse, inner)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._walk_scope(stmt.body, {})
+        elif isinstance(stmt, ast.ClassDef):
+            self._walk_scope(stmt.body, dict(env))
+        elif isinstance(stmt, (ast.If, ast.While)):
+            self._scan_subtree_expr(stmt.test, env)
+            self._walk_scope(stmt.body, env)
+            self._walk_scope(stmt.orelse, env)
+        elif isinstance(stmt, ast.Try):
+            self._walk_scope(stmt.body, env)
+            for h in stmt.handlers:
+                self._walk_scope(h.body, env)
+            self._walk_scope(stmt.orelse, env)
+            self._walk_scope(stmt.finalbody, env)
+        elif isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._scan_subtree_expr(item.context_expr, env)
+            self._walk_scope(stmt.body, env)
+        else:
+            self._scan_subtree(stmt, env)
+
+    def _walk_comp(self, comp, outer_env):
+        env = dict(outer_env)
+        for gen in comp.generators:
+            ev = self._list_event(gen.iter, env)
+            if ev is not None and isinstance(gen.target, ast.Name):
+                env[gen.target.id] = ("item", ev)
+        for node in ast.walk(comp):
+            if node is not comp and isinstance(
+                node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                       ast.DictComp)
+            ):
+                continue  # nested comps get their own _walk_comp pass
+            self._scan_expr(node, env)
+
+    def _scan_subtree(self, stmt, env):
+        for node in ast.walk(stmt):
+            if isinstance(
+                node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                       ast.DictComp, ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            self._scan_expr(node, env)
+
+    def _scan_subtree_expr(self, expr, env):
+        if expr is None:
+            return
+        for node in ast.walk(expr):
+            self._scan_expr(node, env)
+
+    # -- declarative contract tables ------------------------------------
+
+    def _scan_declarative_tables(self):
+        for node in ast.walk(self.module.tree):
+            if not (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                continue
+            name = node.targets[0].id
+            if name == EVENT_DEPS_NAME and isinstance(node.value, ast.Dict):
+                for k, v in zip(node.value.keys, node.value.values):
+                    ev = _str_const(k)
+                    if ev is None:
+                        continue
+                    self.event_reads.append(
+                        EventRead(ev, None, self.module, k)
+                    )
+                    if isinstance(v, (ast.Tuple, ast.List)):
+                        for f in v.elts:
+                            fl = _str_const(f)
+                            if fl is not None:
+                                self.event_reads.append(
+                                    EventRead(ev, fl, self.module, f)
+                                )
+            elif name == SPAN_DEPS_NAME and isinstance(
+                node.value, (ast.Tuple, ast.List)
+            ):
+                for e in node.value.elts:
+                    sp = _str_const(e)
+                    if sp is not None:
+                        self.span_reads.append(
+                            SpanRead(sp, self.module, e)
+                        )
+            elif name == DEFAULT_SERIES_NAME and isinstance(
+                node.value, ast.Dict
+            ):
+                for v in node.value.values:
+                    s = _str_const(v)
+                    if s is not None:
+                        self.series_reads.append(
+                            SeriesRead(s, self.module, v)
+                        )
+
+
+# ---- the whole-project model ------------------------------------------------
+
+
+class ObsModel:
+    def __init__(self, modules, config=None):
+        config = config or DEFAULT_OBS_CONFIG
+        self.config = config
+        self.modules = list(modules)
+        self.index = ProjectIndex(self.modules)
+        self.emits = []
+        self.dynamic_emits = []
+        self.spans = []
+        self.metric_regs = []
+        self.event_reads = []
+        self.series_reads = []
+        self.span_reads = []
+        self.dynamic_regs = 0
+        self.doc_module = None
+        self.doc_catalog = None
+        for m in self.modules:
+            scan = _ModuleScan(m, self.index)
+            for site in scan.emits:
+                (self.emits if site.event is not None
+                 else self.dynamic_emits).append(site)
+            self.spans.extend(scan.spans)
+            self.metric_regs.extend(scan.metric_regs)
+            self.event_reads.extend(scan.event_reads)
+            self.series_reads.extend(scan.series_reads)
+            self.span_reads.extend(scan.span_reads)
+            self.dynamic_regs += len(scan.dynamic_regs)
+            if self.doc_catalog is None:
+                cat = parse_docstring_catalog(m)
+                if cat is not None:
+                    self.doc_module, self.doc_catalog = m, cat
+        self.readme_path = "README.md"
+        self.readme_catalog = self._load_readme(config)
+        self.sites_by_event = {}
+        for site in self.emits:
+            self.sites_by_event.setdefault(site.event, []).append(site)
+        self.span_names = {s.name for s in self.spans}
+        self._hot_emit_cache = None
+
+    def _load_readme(self, config):
+        if config.readme_text is not None:
+            return parse_readme_catalog(config.readme_text)
+        if self.doc_module is None:
+            return None
+        try:
+            readme = (
+                Path(self.doc_module.path).resolve().parent.parent.parent
+                / "README.md"
+            )
+            if readme.is_file():
+                self.readme_path = str(readme)
+                return parse_readme_catalog(
+                    readme.read_text(encoding="utf-8"), path="README.md"
+                )
+        except OSError:
+            pass
+        return None
+
+    @property
+    def cross_surface_armed(self):
+        """Cross-surface rules run only with the catalog in the scan."""
+        return self.doc_catalog is not None
+
+    def producer_fields(self, event):
+        """(union of passed fields, open) across the event's sites."""
+        sites = self.sites_by_event.get(event, [])
+        fields = set()
+        is_open = False
+        for s in sites:
+            fields |= s.fields
+            is_open = is_open or s.open
+        return frozenset(fields), is_open
+
+    def hot_emits(self):
+        """Emit sites lexically inside jaxlint's hot set (OB05 feed):
+        [(FunctionInfo, EmitSite)] for sites in hot functions, computed
+        once."""
+        if self._hot_emit_cache is not None:
+            return self._hot_emit_cache
+        hot = build_hot_set(self.index, self.config.lint)
+        out = []
+        by_node = {}
+        for site in self.emits:
+            fn_node = site.module.enclosing_function(site.node)
+            if fn_node is not None:
+                by_node.setdefault(fn_node, []).append(site)
+        for fn in hot:
+            for site in by_node.get(fn.node, []):
+                out.append((fn, site))
+        self._hot_emit_cache = out
+        return out
+
+    def as_json_dict(self):
+        """The ``--list-events`` payload: the machine-readable catalog."""
+        def loc(x):
+            return {
+                "path": x.module.relpath,
+                "line": getattr(x.node, "lineno", 1),
+            }
+
+        producers = {}
+        for site in self.emits:
+            p = producers.setdefault(
+                site.event, {"sites": [], "fields": set(), "open": False}
+            )
+            p["sites"].append(loc(site))
+            p["fields"] |= site.fields
+            p["open"] = p["open"] or site.open
+        for p in producers.values():
+            p["fields"] = sorted(p["fields"])
+        return {
+            "producers": {
+                k: producers[k] for k in sorted(producers)
+            },
+            "spans": sorted(self.span_names),
+            "metrics": sorted(
+                {
+                    ("~" + r.name) if r.wildcard else r.name
+                    for r in self.metric_regs
+                }
+            ),
+            "catalog": {
+                "docstring": sorted(self.doc_catalog)
+                if self.doc_catalog else None,
+                "readme": sorted(self.readme_catalog)
+                if self.readme_catalog else None,
+            },
+            "consumers": {
+                "events": sorted(
+                    {
+                        f"{r.event}.{r.field}" if r.field else r.event
+                        for r in self.event_reads
+                    }
+                ),
+                "series": sorted({r.name for r in self.series_reads}),
+                "spans": sorted({r.name for r in self.span_reads}),
+            },
+            "dynamic": {
+                "emits": len(self.dynamic_emits),
+                "metric_registrations": self.dynamic_regs,
+            },
+        }

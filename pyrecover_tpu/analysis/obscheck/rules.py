@@ -1,0 +1,313 @@
+"""The OB rule catalog: six checks over the observability triangle.
+
+Producers (emit/span/metric sites), catalogs (telemetry docstring +
+README event table), and consumers (doctor / summarizer / aggregator /
+top / exporter reads) must agree; each OB rule checks one edge:
+
+* **OB01 unknown-event** — an ``emit()`` with a literal name that is in
+  *neither* catalog: the event exists in code only, invisible to every
+  reader who starts from the documentation.
+* **OB02 phantom-catalog-entry** — a catalog or README row with zero
+  emit sites: documentation for an event that was renamed or retired.
+* **OB03 consumer-field-drift** — a consumer reads an event nobody
+  emits, a field no producer site ever passes, or a span name no span
+  helper opens: the read is dead and its downstream section/diagnosis
+  silently degrades.
+* **OB04 catalog-divergence** — the docstring catalog and the README
+  table disagree on an event's existence, or (both sides closed) on its
+  field set.
+* **OB05 hot-path-emit** — an unconditional emit lexically inside
+  jaxlint's hot set (``hot-loop`` markers + ``_train_impl`` reachability
+  — the cross-tool marker channel concur already consumes) with no
+  ``# obscheck: once`` marker on its function: per-step host work on the
+  training fast path.
+* **OB06 metric-name-drift** — the exporter/aggregator/top consume a
+  metric series never registered (literal, alias, tuple-loop, or
+  f-string-wildcard site).
+
+Cross-surface rules (all but OB05) arm only when the docstring catalog
+module is part of the scan — see ``model.py``.
+"""
+
+import dataclasses
+
+from pyrecover_tpu.analysis.engine import (
+    Finding,
+    ModuleInfo,
+    _load_modules,
+)
+from pyrecover_tpu.analysis.obscheck.model import (
+    DEFAULT_OBS_CONFIG,
+    ENVELOPE_FIELDS,
+    ObsModel,
+)
+
+OB_RULES = {}
+
+
+@dataclasses.dataclass
+class Rule:
+    id: str
+    name: str
+    severity: str
+    summary: str
+    check: object
+
+
+def rule(rule_id, name, severity, summary):
+    def register(fn):
+        OB_RULES[name] = Rule(rule_id, name, severity, summary, fn)
+        return fn
+
+    return register
+
+
+def finding(r, module, node, message):
+    return Finding(
+        rule=r.name,
+        rule_id=r.id,
+        severity=r.severity,
+        path=module.relpath,
+        line=getattr(node, "lineno", 1),
+        col=getattr(node, "col_offset", 0) + 1,
+        message=message,
+    )
+
+
+def _raw_finding(r, path, line, message):
+    return Finding(
+        rule=r.name, rule_id=r.id, severity=r.severity,
+        path=path, line=line, col=1, message=message,
+    )
+
+
+@rule(
+    "OB01", "unknown-event", "error",
+    "emit() with a literal event name in neither catalog",
+)
+def check_unknown_event(model, config):
+    if not model.cross_surface_armed:
+        return
+    readme = model.readme_catalog or {}
+    for site in model.emits:
+        if site.event in model.doc_catalog or site.event in readme:
+            continue
+        yield finding(
+            OB_RULES["unknown-event"], site.module, site.node,
+            f'emit("{site.event}") is documented in neither the '
+            f"telemetry docstring catalog nor the README event table",
+        )
+
+
+@rule(
+    "OB02", "phantom-catalog-entry", "warning",
+    "catalog/README row for an event with zero emit sites",
+)
+def check_phantom_entry(model, config):
+    if not model.cross_surface_armed:
+        return
+    r = OB_RULES["phantom-catalog-entry"]
+    for catalog, label in (
+        (model.doc_catalog, "docstring catalog"),
+        (model.readme_catalog or {}, "README event table"),
+    ):
+        for name, entry in catalog.items():
+            if name in model.sites_by_event:
+                continue
+            yield _raw_finding(
+                r, entry.path, entry.line,
+                f'{label} documents "{name}" but no emit site produces '
+                f"it (renamed or retired?)",
+            )
+
+
+@rule(
+    "OB03", "consumer-field-drift", "error",
+    "consumer reads an event/field/span no producer ever passes",
+)
+def check_consumer_drift(model, config):
+    if not model.cross_surface_armed:
+        return
+    r = OB_RULES["consumer-field-drift"]
+    seen = set()
+    for read in model.event_reads:
+        if read.event not in model.sites_by_event:
+            key = (read.module.relpath, getattr(read.node, "lineno", 1),
+                   read.event, None)
+            if key in seen:
+                continue
+            seen.add(key)
+            yield finding(
+                r, read.module, read.node,
+                f'consumer reads event "{read.event}" that no producer '
+                f"emits",
+            )
+            continue
+        if read.field is None or read.field in ENVELOPE_FIELDS:
+            continue
+        fields, is_open = model.producer_fields(read.event)
+        if is_open or read.field in fields:
+            continue
+        key = (read.module.relpath, getattr(read.node, "lineno", 1),
+               read.event, read.field)
+        if key in seen:
+            continue
+        seen.add(key)
+        yield finding(
+            r, read.module, read.node,
+            f'consumer reads field "{read.field}" of event '
+            f'"{read.event}" but no emit site passes it',
+        )
+    for read in model.span_reads:
+        if read.name in model.span_names:
+            continue
+        yield finding(
+            r, read.module, read.node,
+            f'consumer depends on span "{read.name}" that no span '
+            f"helper opens",
+        )
+
+
+@rule(
+    "OB04", "catalog-divergence", "warning",
+    "docstring catalog and README table disagree",
+)
+def check_catalog_divergence(model, config):
+    if not model.cross_surface_armed or model.readme_catalog is None:
+        return
+    r = OB_RULES["catalog-divergence"]
+    doc, readme = model.doc_catalog, model.readme_catalog
+    for name, entry in doc.items():
+        if name not in readme:
+            yield _raw_finding(
+                r, entry.path, entry.line,
+                f'"{name}" is in the docstring catalog but missing from '
+                f"the README event table",
+            )
+            continue
+        other = readme[name]
+        if entry.open or other.open or entry.fields == other.fields:
+            continue
+        only_doc = sorted(entry.fields - other.fields)
+        only_readme = sorted(other.fields - entry.fields)
+        delta = []
+        if only_doc:
+            delta.append(f"docstring-only: {', '.join(only_doc)}")
+        if only_readme:
+            delta.append(f"README-only: {', '.join(only_readme)}")
+        yield _raw_finding(
+            r, entry.path, entry.line,
+            f'the two catalogs disagree on "{name}" fields '
+            f"({'; '.join(delta)})",
+        )
+    for name, entry in readme.items():
+        if name not in doc:
+            yield _raw_finding(
+                r, entry.path, entry.line,
+                f'"{name}" is in the README event table but missing '
+                f"from the docstring catalog",
+            )
+
+
+@rule(
+    "OB05", "hot-path-emit", "warning",
+    "unconditional emit inside a jaxlint hot-loop region",
+)
+def check_hot_path_emit(model, config):
+    r = OB_RULES["hot-path-emit"]
+    for fn, site in model.hot_emits():
+        if site.guarded:
+            continue
+        if "once" in fn.markers:
+            continue
+        name = site.event if site.event is not None else "<dynamic>"
+        yield finding(
+            r, site.module, site.node,
+            f'unconditional emit("{name}") in hot function '
+            f"`{fn.qualname}` — guard it, buffer it, or mark the "
+            f"function `# obscheck: once`",
+        )
+
+
+@rule(
+    "OB06", "metric-name-drift", "error",
+    "a consumed metric series is never registered",
+)
+def check_metric_drift(model, config):
+    import re as _re
+
+    if not model.cross_surface_armed:
+        return
+    r = OB_RULES["metric-name-drift"]
+    literal = {m.name for m in model.metric_regs if not m.wildcard}
+    patterns = [
+        _re.compile(m.name) for m in model.metric_regs if m.wildcard
+    ]
+    seen = set()
+    for read in model.series_reads:
+        if read.name in literal:
+            continue
+        if any(p.fullmatch(read.name) for p in patterns):
+            continue
+        key = (read.module.relpath, getattr(read.node, "lineno", 1),
+               read.name)
+        if key in seen:
+            continue
+        seen.add(key)
+        yield finding(
+            r, read.module, read.node,
+            f'series "{read.name}" is consumed but never registered as '
+            f"a counter/gauge/histogram",
+        )
+
+
+@dataclasses.dataclass
+class ObsResult:
+    findings: list
+    files_scanned: int
+
+    @property
+    def unsuppressed(self):
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self):
+        return [f for f in self.findings if f.suppressed]
+
+
+def analyze_modules(modules, config=None, pre_findings=()):
+    config = config or DEFAULT_OBS_CONFIG
+    model = ObsModel(modules, config)
+    by_path = {m.relpath: m for m in modules}
+    findings = list(pre_findings)
+    for r in OB_RULES.values():
+        if not config.rule_enabled(r.name, r.id):
+            continue
+        findings.extend(r.check(model, config))
+    for f in findings:
+        module = by_path.get(f.path)
+        if module is not None:
+            f.suppressed, f.justification = module.suppression_for(
+                f.rule, f.rule_id, f.line
+            )
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
+    return ObsResult(
+        findings=findings, files_scanned=len(modules) + len(pre_findings)
+    )
+
+
+def analyze_paths(paths, config=None):
+    modules, pre = _load_modules(paths, tool="obscheck", error_id="OB00")
+    return analyze_modules(modules, config, pre_findings=pre)
+
+
+def analyze_source(source, name="<snippet>", config=None):
+    module = ModuleInfo(name, source, relpath=name, tool="obscheck")
+    return analyze_modules([module], config)
+
+
+def build_model(paths, config=None):
+    """The extracted observability model for ``--list-events`` and the
+    test suite's shared catalog-pin helper (no rules run)."""
+    modules, _pre = _load_modules(paths, tool="obscheck", error_id="OB00")
+    return ObsModel(modules, config or DEFAULT_OBS_CONFIG)

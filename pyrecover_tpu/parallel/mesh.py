@@ -201,7 +201,7 @@ def state_topology(state):
 _dropped_axes_warned = set()
 
 
-def _note_dropped_axis(axis, axis_names):
+def _note_dropped_axis(axis, axis_names):  # obscheck: once
     """A spec named an axis the mesh does not have AT ALL (not a manual
     axis being filtered — those are deliberate): the dimension will be
     silently replicated, which is exactly how a typo'd or stale axis name

@@ -218,7 +218,7 @@ class PreemptionWatcher:
     def disarm_escalation(self):
         self._escalation = None
 
-    def _escalate(self, signum):
+    def _escalate(self, signum):  # obscheck: once
         """Second signal mid-save: publish the requeue marker NOW and exit.
         Runs inside the signal handler (main thread, between bytecodes) —
         ``os._exit`` skips interpreter teardown deliberately: the process

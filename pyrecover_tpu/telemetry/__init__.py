@@ -26,6 +26,10 @@ Core event names across the stack (fields beyond the envelope):
                       that OVERLAPPED training — recovered goodput, split
                       from the blocking stall in WallTimeTotals)
     ckpt_save_durable engine, wait_s
+    ckpt_saved        engine, path, step, blocking_s, final (one fully
+                      committed save; the goodput-autopilot decision
+                      trail and the summarizer's static-policy
+                      counterfactual both key on it)
     ckpt_backpressure engine, path, wait_s (a save arrived while the
                       previous zerostall save was still in flight; the
                       depth-1 queue made it wait, loudly)
@@ -43,6 +47,9 @@ Core event names across the stack (fields beyond the envelope):
                       disk tier bypassed)
     emergency_restore_rejected  reason[, step] (the strict freshness/
                       digest gate refused the RAM record; disk wins)
+    emergency_peer_exchange  engine, step, exp_dir, leaves, bytes (the
+                      host-0-verdict-broadcast RAM exchange landed the
+                      committed snapshot in every host's RAM)
     distributed_wait_timeout  phase, timeout_s (a collective_phase-bounded
                       cross-host wait — barrier / verdict broadcast /
                       peer RAM exchange — outlived its bound: some host

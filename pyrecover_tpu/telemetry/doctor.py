@@ -47,6 +47,44 @@ CLASSES = (
     "platform_fallback", "recompile_storm", "unknown",
 )
 
+# The doctor's observability contract, spelled once. Every event name the
+# classifier keys on, mapped to the non-envelope fields it reads off that
+# event (() = presence/count only). obscheck parses this exact table as
+# declarative consumer reads, so an event renamed at its emit site — or a
+# field dropped from its kwargs — fails the static gate (OB01/OB03)
+# instead of silently degrading a postmortem verdict to `unknown`. The
+# classifier routes its own counter lookups through ``_count`` below, so
+# a name used in code but missing here fails loudly in tests too.
+EVENT_DEPS = {
+    "run_start": (),
+    "run_summary": ("status", "step", "hbm_peak_pct"),
+    "span_begin": ("span", "name", "phase"),
+    "span_end": ("span",),
+    "recompile": (),
+    "implicit_transfer": (),
+    "platform_fallback": ("reason",),
+    "topology_mismatch": ("reason",),
+    "elastic_preflight_failed": ("reason",),
+    "elastic_resume": ("resharded_leaves", "target_topology"),
+    "distributed_wait_timeout": ("phase", "timeout_s"),
+    "hang_detected": ("silent_s",),
+    "preempt_signal_escalation": (),
+    "preempt_stop": ("reason",),
+    "slo_alert": ("rule", "kind", "threshold", "state", "value"),
+}
+
+# span names whose open-at-death presence changes the verdict
+SPAN_DEPS = ("collective_wait",)
+
+
+def _count(counts, name):
+    """Counter lookup gated on the declared contract: a classifier that
+    keys on an event absent from EVENT_DEPS is a bug, not a zero."""
+    if name not in EVENT_DEPS:
+        raise KeyError(f"event {name!r} not declared in doctor.EVENT_DEPS")
+    return counts.get(name, 0)
+
+
 DEFAULT_RECOMPILE_STORM = 3
 
 _OOM_RE = re.compile(
@@ -218,18 +256,18 @@ def analyze(evidence, *, recompile_storm_threshold=DEFAULT_RECOMPILE_STORM):
         finding("bundle", f"{man.get('reason', '?')} at {b['path']}")
     if summary is not None and summary.get("status") == "error":
         finding("run_summary", f"status=error at step {summary.get('step')}")
-    n_recompiles = counts.get("recompile", 0)
+    n_recompiles = _count(counts, "recompile")
     if n_recompiles:
         finding("recompile", f"{n_recompiles} train-step retrace(s)")
-    n_transfers = counts.get("implicit_transfer", 0)
+    n_transfers = _count(counts, "implicit_transfer")
     if n_transfers:
         finding("implicit_transfer", f"{n_transfers} implicit transfer(s)")
-    n_fallback = counts.get("platform_fallback", 0)
+    n_fallback = _count(counts, "platform_fallback")
     for e in seg:
         if e.get("event") == "platform_fallback":
             finding("platform_fallback", e.get("reason", ""))
-    n_topology = counts.get("topology_mismatch", 0) + counts.get(
-        "elastic_preflight_failed", 0
+    n_topology = _count(counts, "topology_mismatch") + _count(
+        counts, "elastic_preflight_failed"
     )
     for e in seg:
         if e.get("event") in ("topology_mismatch", "elastic_preflight_failed"):
@@ -247,7 +285,7 @@ def analyze(evidence, *, recompile_storm_threshold=DEFAULT_RECOMPILE_STORM):
     # prevent. The collective_wait span's `phase` field (set by
     # telemetry.collective_phase) names the protocol step.
     coll_spans = [
-        r for r in open_records if r.get("name") == "collective_wait"
+        r for r in open_records if r.get("name") in SPAN_DEPS
     ]
     for r in coll_spans:
         finding(
@@ -256,7 +294,7 @@ def analyze(evidence, *, recompile_storm_threshold=DEFAULT_RECOMPILE_STORM):
             "this host was waiting in a cross-host collective its peers "
             "never completed",
         )
-    n_wait_timeouts = counts.get("distributed_wait_timeout", 0)
+    n_wait_timeouts = _count(counts, "distributed_wait_timeout")
     for e in seg:
         if e.get("event") == "distributed_wait_timeout":
             finding(
@@ -265,7 +303,7 @@ def analyze(evidence, *, recompile_storm_threshold=DEFAULT_RECOMPILE_STORM):
                 f"{e.get('timeout_s', '?')}s bound "
                 "(distributed_wait_timeout)",
             )
-    n_hangs = counts.get("hang_detected", 0)
+    n_hangs = _count(counts, "hang_detected")
     if n_hangs:
         silences = [
             e.get("silent_s") for e in seg
@@ -376,13 +414,13 @@ def analyze(evidence, *, recompile_storm_threshold=DEFAULT_RECOMPILE_STORM):
             )
         )
     elif (
-        counts.get("preempt_signal_escalation")
+        _count(counts, "preempt_signal_escalation")
         or bundle_reason == "preempt_escalation"
-        or counts.get("preempt_stop")
+        or _count(counts, "preempt_stop")
         or (summary is not None and summary.get("status") == "stopped_early")
     ):
         cls = "preemption"
-        if counts.get("preempt_signal_escalation") or (
+        if _count(counts, "preempt_signal_escalation") or (
             bundle_reason == "preempt_escalation"
         ):
             detail = "second signal mid-save: escalated to immediate exit"

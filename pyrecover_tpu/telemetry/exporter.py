@@ -65,6 +65,16 @@ RULES_ENV = "PYRECOVER_SLO_RULES"
 
 DEFAULT_RULES = "request_p99>2.0,step_regress>2.0,backpressure_duty>0.5"
 
+# alert-kind → the metric series it measures, unless the rule overrides
+# it. Module-level so obscheck's consumer extraction sees the exporter's
+# series dependencies declaratively (a rename of e2e_s/step_iter_s at
+# the registration site fails the OB06 gate, not the first live window).
+DEFAULT_SERIES = {
+    "request_p99": "e2e_s",
+    "step_regress": "step_iter_s",
+    "backpressure_duty": "serving_backpressure_total",
+}
+
 _PROM_PREFIX = "pyrecover_"
 
 
@@ -88,11 +98,7 @@ class AlertRule:
         self.kind = kind
         self.threshold = float(threshold)
         self.window_s = float(window_s)
-        self.series = series or {
-            "request_p99": "e2e_s",
-            "step_regress": "step_iter_s",
-            "backpressure_duty": "serving_backpressure_total",
-        }[kind]
+        self.series = series or DEFAULT_SERIES[kind]
         self.name = name or kind
 
     def as_dict(self):  # jaxlint: host-only

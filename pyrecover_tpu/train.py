@@ -825,6 +825,8 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
     from pyrecover_tpu.telemetry.exporter import maybe_start_from_env
 
     status["exporter"] = maybe_start_from_env()
+    # obscheck: disable-next=hot-path-emit -- once per run, emitted
+    # before the first loop iteration (OB05 is function-granular)
     telemetry.emit(
         "run_start",
         devices=jax.device_count(),
@@ -969,6 +971,8 @@ def _train_impl(config, totals, t_entry, owned_sinks, status):
         totals.ckpt_blocking_s += secs
         telemetry.metrics.histogram("ckpt_blocking_s").observe(secs)
         log_host0("Saved checkpoint %s in %.2f s", path.name, secs)
+        # obscheck: disable-next=hot-path-emit -- once per SAVE, not per
+        # step: every save_ckpt call is interval-gated by its caller
         telemetry.emit(
             "ckpt_saved", step=int(step), path=path.name, final=bool(final),
             engine=engine, blocking_s=round(secs, 4),

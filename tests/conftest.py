@@ -101,6 +101,54 @@ def run_train_steps(mesh_cfg, model_cfg, train_cfg, n_steps=3, data_seed=3):
     return state, losses
 
 
+_OBS_MODEL = None
+
+
+def obs_model():
+    """The repo's extracted observability model (obscheck over
+    ``pyrecover_tpu/``), built once per test session. The per-feature
+    catalog-pin tests consult this instead of each re-implementing its
+    own grep-the-docstring check."""
+    global _OBS_MODEL
+    if _OBS_MODEL is None:
+        from pyrecover_tpu.analysis.obscheck import build_model
+
+        _OBS_MODEL = build_model(
+            [_Path(__file__).resolve().parent.parent / "pyrecover_tpu"]
+        )
+    return _OBS_MODEL
+
+
+def assert_observed(events=(), metrics=(), spans=()):
+    """Shared catalog pin: every ``events`` name must have >=1 literal
+    emit site AND an entry in BOTH catalogs (the telemetry docstring and
+    the README event table — parsed entries, not substring hits); every
+    ``metrics`` name a registration site (wildcards honored); every
+    ``spans`` name a span site."""
+    import re
+
+    m = obs_model()
+    assert m.cross_surface_armed, "telemetry docstring catalog not found"
+    assert m.readme_catalog is not None, "README event table not found"
+    for name in events:
+        assert name in m.sites_by_event, f"{name}: no emit site in the tree"
+        assert name in m.doc_catalog, (
+            f"{name} missing from the telemetry docstring catalog"
+        )
+        assert name in m.readme_catalog, (
+            f"{name} missing from the README event table"
+        )
+    if metrics:
+        literal = {r.name for r in m.metric_regs if not r.wildcard}
+        wild = [r.name for r in m.metric_regs if r.wildcard]
+        for name in metrics:
+            assert name in literal or any(
+                re.fullmatch(p, name) for p in wild
+            ), f"{name}: no metric registration site"
+    for name in spans:
+        assert name in m.span_names, f"{name}: no span site in the tree"
+
+
 def assert_params_match(ref_state, state, rtol=2e-3, atol=2e-3):
     """Per-leaf closeness of two TrainState param trees (the standard
     sharded-vs-single-device equality check; strict zip catches a

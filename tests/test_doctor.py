@@ -339,3 +339,20 @@ def test_cli_expect_gate(tmp_path, capsys):
     assert doctor.main([str(root), "--expect", "hang"]) == 0
     assert doctor.main([str(root), "--expect", "healthy"]) == 3
     capsys.readouterr()
+
+
+# ---- declarative observability contract -------------------------------------
+
+def test_event_deps_table_gates_counter_lookups():
+    """The classifier's counter reads route through _count, which
+    refuses event names absent from EVENT_DEPS — using an undeclared
+    event is a loud bug, not a silent zero (and obscheck reads the same
+    table as the doctor's consumer contract)."""
+    assert doctor._count({"recompile": 2}, "recompile") == 2
+    assert doctor._count({}, "hang_detected") == 0
+    with pytest.raises(KeyError, match="EVENT_DEPS"):
+        doctor._count({}, "never_declared_event")
+    # every classifier-consumed name the module references is declared
+    for name in ("run_summary", "preempt_stop", "slo_alert", "span_begin"):
+        assert name in doctor.EVENT_DEPS
+    assert doctor.SPAN_DEPS == ("collective_wait",)

@@ -730,20 +730,19 @@ def test_concur_suppressions_justified_in_serving():
 
 
 def test_serving_events_documented_in_both_catalogs():
-    """request_admitted / request_done / kv_backpressure /
-    weights_loaded and the latency histograms must appear in BOTH event
-    catalogs (telemetry/__init__ docstring + README table)."""
-    import pyrecover_tpu.telemetry as t
+    """The serving plane in the extracted observability model: emit
+    sites + BOTH catalog entries for the events, registration sites for
+    the latency histograms, span sites for the request phases (shared
+    obscheck-model pin, see conftest.assert_observed)."""
+    from conftest import assert_observed
 
-    readme = (REPO / "README.md").read_text()
-    for name in ("request_admitted", "request_done", "kv_backpressure",
-                 "weights_loaded", "ttft_s", "tpot_s", "e2e_s",
-                 "req_queue", "req_prefill", "req_decode"):
-        assert name in t.__doc__, f"{name} missing from telemetry catalog"
-    for name in ("request_admitted", "request_done", "kv_backpressure",
-                 "weights_loaded", "ttft_s"):
-        assert name in readme, f"{name} missing from README event table"
-    assert "## Serving" in readme
+    assert_observed(
+        events=("request_admitted", "request_done", "kv_backpressure",
+                "weights_loaded"),
+        metrics=("ttft_s", "tpot_s", "e2e_s"),
+        spans=("req_queue", "req_prefill", "req_decode"),
+    )
+    assert "## Serving" in (REPO / "README.md").read_text()
 
 
 # ---- decode.py satellite: lockstep stays the equality baseline ---------

@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""obscheck CLI — static observability-contract analysis.
+
+Usage:
+    python tools/obscheck.py pyrecover_tpu/ --strict
+    python tools/obscheck.py --list-rules
+    python tools/obscheck.py pyrecover_tpu/ --list-events
+    python tools/obscheck.py pyrecover_tpu/ --json /tmp/obscheck.json
+
+All logic lives in ``pyrecover_tpu.analysis.obscheck`` (observability
+model in ``model.py``, rules OB01–OB06 in ``rules.py``, suppression
+syntax shared with jaxlint/concur/distcheck under the ``obscheck:``
+comment namespace); this file is the executable shim so the analyzer is
+runnable before the package is installed.
+"""
+
+import sys
+from pathlib import Path
+
+# runnable from any cwd, installed or not
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from pyrecover_tpu.analysis.obscheck.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
