@@ -35,11 +35,12 @@ from pathlib import Path
 # The engine serves more than one analyzer: jaxlint (this package's
 # original tenant), concur (analysis/concur — the concurrency-safety
 # analyzer), distcheck (analysis/distcheck — the multi-host
-# collective-congruence analyzer), and obscheck (analysis/obscheck —
-# the observability-contract analyzer) share the parsing, suppression,
-# and marker machinery, each under its own comment namespace
-# (``# jaxlint: ...`` / ``# concur: ...`` / ``# distcheck: ...`` /
-# ``# obscheck: ...``).
+# collective-congruence analyzer), obscheck (analysis/obscheck — the
+# observability-contract analyzer), and faultcheck (analysis/faultcheck
+# — the crash-consistency/fault-coverage analyzer) share the parsing,
+# suppression, and marker machinery, each under its own comment
+# namespace (``# jaxlint: ...`` / ``# concur: ...`` /
+# ``# distcheck: ...`` / ``# obscheck: ...`` / ``# faultcheck: ...``).
 # Directives (disable/disable-next/disable-file) are TOOL-SCOPED: a
 # ModuleInfo parses only its own tool's suppressions, so a jaxlint
 # suppression can never silence a concur or distcheck finding, or vice
@@ -50,13 +51,17 @@ from pathlib import Path
 # (function's return agrees across hosts) declarations, obscheck
 # consumes jaxlint's ``hot-loop`` reachability markers plus its own
 # ``once`` marker (function emits at most once per run — a warn-once /
-# once-per-run guard the AST cannot always see), and each tool simply
-# ignores the markers it has no meaning for.
+# once-per-run guard the AST cannot always see), faultcheck consumes
+# its own ``tear-ok`` marker (function's renames publish advisory
+# artifacts — torn/unsynced bytes are acceptable, so the durability
+# rules stand down), and each tool simply ignores the markers it has no
+# meaning for.
 _MARKERS_BY_TOOL = {
     "jaxlint": r"hot-loop|sync-point|host-only",
     "concur": r"guarded-by=[\w.\-]+",
     "distcheck": r"host-local|congruent",
     "obscheck": r"once",
+    "faultcheck": r"tear-ok",
 }
 
 _DIRECTIVE_RES = {}

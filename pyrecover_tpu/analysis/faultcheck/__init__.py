@@ -1,0 +1,73 @@
+"""faultcheck — static crash-consistency & fault-coverage analysis.
+
+The sixth axis of the analysis space: jaxlint checks JAX *syntax*
+hazards, shardcheck checks SPMD *launch semantics*, concur checks
+*threading semantics*, distcheck checks *control-flow congruence*,
+obscheck checks the *observability contract* — and faultcheck checks
+the **durability contract**: the property that every durable effect
+(tmp→fsync→rename publish chains, GC unlinks, retention deletes) is
+crash-ordered, sits behind a ``faults.check`` seam the chaos harness
+can kill, is declared in the ``FAULT_SITES`` registry, and is actually
+rehearsed by some drill — and that error paths release what they
+acquired (pool blocks, pin leases, subprocesses) and recovery code
+never swallows corruption into silence. Its failure mode is the one no
+green test reliably catches: a new writer lands without a seam, and
+every chaos drill still passes — because the harness structurally
+cannot kill the one place the new code can tear. The repo proves
+crash-consistency *dynamically* (chaos drills, kill-site sweeps); this
+analyzer proves the *discipline* that makes those drills meaningful,
+statically, on every commit — the posture production pre-training
+frameworks treat as a first-class invariant (TorchTitan, arxiv
+2410.06511) and dynamic fault tolerance assumes before it can be
+trusted (arxiv 2511.08381).
+
+The analyzer reuses the shared engine end to end: the same
+:class:`~pyrecover_tpu.analysis.engine.ModuleInfo` parsing, the same
+cross-module call graph (FT02 walks call edges from each effect chain
+to its nearest seam), the same suppression syntax under the
+``faultcheck:`` comment namespace (tool-scoped: a jaxlint/concur/
+distcheck/obscheck disable can never silence an FT finding, nor the
+reverse), and the same text/JSON reporters. ``model.py`` extracts the
+durability model — effect chains with intra-function crash ordering,
+seams with their site strings, the declarative ``FAULT_SITES``
+registry plus the fault classes' site/op declarations, every chaos
+preset and kill-site test plan resolved to the sites it fires, and
+paired resource acquire/releases with per-path escape analysis.
+
+The rule catalog (``rules.py``): FT01 publish-before-durability, FT02
+unseamed-durable-effect, FT03 seam-drift, FT04 undrilled-seam, FT05
+leak-on-error, FT06 recovery-swallow.
+
+Function markers steer the model (parsed cross-tool like jaxlint's)::
+
+    def _rotate(...):   # faultcheck: tear-ok   <- advisory artifact;
+                                                   torn bytes acceptable
+
+Suppressions carry jaxlint's exact shape under the ``faultcheck:``
+namespace, and the test suite rejects justification-free ones::
+
+    os.replace(tmp, dst)  # faultcheck: disable=publish-before-durability -- why
+
+CLI: ``tools/faultcheck.py`` (console script ``faultcheck``), gated in
+``format.sh`` with ``--strict`` over the whole repo; ``--list-sites``
+dumps the machine-readable durability model.
+"""
+
+from pyrecover_tpu.analysis.faultcheck.model import FaultConfig, FaultModel
+from pyrecover_tpu.analysis.faultcheck.rules import (
+    FT_RULES,
+    analyze_modules,
+    analyze_paths,
+    analyze_source,
+    build_model,
+)
+
+__all__ = [
+    "FT_RULES",
+    "FaultConfig",
+    "FaultModel",
+    "analyze_modules",
+    "analyze_paths",
+    "analyze_source",
+    "build_model",
+]

@@ -1,0 +1,131 @@
+"""faultcheck command line (the engine behind ``tools/faultcheck.py``).
+
+Mirrors the jaxlint/concur/distcheck/shardcheck/obscheck CLI contract
+exactly — same flags, same exit codes (0 clean / report-only, 1
+unsuppressed findings under ``--strict``, 2 usage error), same
+text/JSON report shapes — so CI tooling consumes all six analyzers with
+one set of plumbing. One addition: ``--list-sites`` dumps the extracted
+durability model (registry, seams, effect chains, drills, resources) as
+JSON — the obscheck ``--list-events`` precedent applied to faults.
+"""
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from pyrecover_tpu.analysis.faultcheck.model import FaultConfig
+from pyrecover_tpu.analysis.faultcheck.rules import (
+    FT_RULES,
+    analyze_paths,
+    build_model,
+)
+from pyrecover_tpu.analysis.report import render_json, render_text
+
+
+def _build_parser():
+    p = argparse.ArgumentParser(
+        prog="faultcheck",
+        description=(
+            "Static crash-consistency and fault-coverage analysis: "
+            "unsynced publishes, unseamed durable effects, seam/registry "
+            "drift, undrilled sites, error-path resource leaks, "
+            "recovery-path exception swallows."
+        ),
+    )
+    p.add_argument(
+        "paths", nargs="*", default=["pyrecover_tpu"],
+        help="files or directories to analyze (default: pyrecover_tpu)",
+    )
+    p.add_argument(
+        "--strict", action="store_true",
+        help="exit non-zero on any unsuppressed finding (the CI gate)",
+    )
+    p.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="stdout format",
+    )
+    p.add_argument(
+        "--json", metavar="PATH", default=None,
+        help="also write the JSON report to PATH (works with --format text)",
+    )
+    p.add_argument(
+        "--select", default=None, metavar="RULES",
+        help="comma-separated rule names/ids to run (default: all)",
+    )
+    p.add_argument(
+        "--ignore", default=None, metavar="RULES",
+        help="comma-separated rule names/ids to skip",
+    )
+    p.add_argument(
+        "--show-suppressed", action="store_true",
+        help="include suppressed findings (with justifications) in text "
+        "output",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    p.add_argument(
+        "--list-sites", action="store_true",
+        help="dump the extracted durability model (registry, seams, "
+        "effect chains, drills, resources) as JSON and exit (no rules "
+        "run)",
+    )
+    return p
+
+
+def _csv_set(raw):
+    return frozenset(x.strip() for x in raw.split(",") if x.strip())
+
+
+def main(argv=None):
+    args = _build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for r in FT_RULES.values():
+            print(f"{r.id}  {r.name:<36} {r.severity:<7} {r.summary}")
+        return 0
+
+    missing = [p for p in args.paths if not Path(p).exists()]
+    if missing:
+        print(
+            f"faultcheck: no such path: {', '.join(missing)}",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.list_sites:
+        model = build_model(args.paths)
+        print(json.dumps(model.as_json_dict(), indent=2, sort_keys=False))
+        return 0
+
+    config = FaultConfig()
+    if args.select or args.ignore:
+        config = FaultConfig(
+            select=_csv_set(args.select) if args.select else None,
+            ignore=_csv_set(args.ignore) if args.ignore else frozenset(),
+        )
+
+    result = analyze_paths(args.paths, config)
+
+    if args.json:
+        # jaxlint: disable-next=torn-write -- CI report artifact,
+        # regenerated every run; a torn report fails its consumer loudly
+        Path(args.json).write_text(
+            render_json(result, strict=args.strict, tool="faultcheck")
+            + "\n",
+            encoding="utf-8",
+        )
+    if args.format == "json":
+        print(render_json(result, strict=args.strict, tool="faultcheck"))
+    else:
+        print(render_text(result, show_suppressed=args.show_suppressed))
+
+    if args.strict and result.unsuppressed:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

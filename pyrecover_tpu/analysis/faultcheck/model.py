@@ -1,0 +1,694 @@
+"""Durability-model extraction: effect chains, seams, registry, drills.
+
+The model is four static surfaces the FT rules cross-check:
+
+* **effect chains** — per outermost function, the ordered sequence of
+  durable-effect events: payload staging (``mkstemp``), payload writes
+  (``.write``/``write_text``/``write_bytes``), ``os.fsync``, publishes
+  (``os.replace``/``os.rename`` and one-arg ``.replace``/``.rename``
+  method calls — ``str.replace`` takes two arguments, so the arity
+  disambiguates), and unlinks (``.unlink``/``os.remove``/
+  ``shutil.rmtree``). Events inside nested defs fold into the outermost
+  function (the vanilla writer's seam/fsync/rename closures), ordered
+  by line — which is exactly the crash order a ``kill -9`` sees.
+* **seams** — every ``faults.check(site, ...)`` call with its literal
+  site string (or ``None`` when dynamic) and enclosing functions.
+* **site registry** — the declarative ``FAULT_SITES`` dict in
+  ``resilience/faults.py`` (any scanned module assigning a
+  ``FAULT_SITES`` dict literal arms the registry rules), plus the fault
+  classes' ``type_name``/``sites``/``_OPS`` declarations so drill plan
+  dicts can be resolved to the sites they fire.
+* **drill refs** — every plan-spec dict literal (``{"type": ..., ...}``)
+  in the scanned modules AND the auto-discovered test corpus (the
+  ``tests/`` directory beside the registry module's package — the gate
+  paths deliberately exclude tests, but drills live there), resolved to
+  the set of sites it can fire.
+
+Plus the **resource model**: paired acquire/release sites
+(``kvpool.alloc``/``release``, ``pins.pin_manifest``→lease ``release``,
+``subprocess.Popen`` spawn/kill, save-handle ``wait``) with per-path
+escape facts (protecting ``with``, release-in-finally/handler, handoff
+via return or attribute storage) for FT05.
+"""
+
+import ast
+import dataclasses
+from pathlib import Path
+
+from pyrecover_tpu.analysis.callgraph import ProjectIndex, dotted_name
+from pyrecover_tpu.analysis.engine import _load_modules
+
+REGISTRY_NAME = "FAULT_SITES"
+
+# event kinds, in the vocabulary the rules and --list-sites share
+STAGE, WRITE, FSYNC, PUBLISH, UNLINK = (
+    "stage", "write", "fsync", "publish", "unlink"
+)
+
+_WRITE_ATTRS = frozenset({"write", "writelines", "write_text", "write_bytes"})
+_UNLINK_DOTTED = frozenset({"os.unlink", "os.remove", "shutil.rmtree"})
+_PUBLISH_DOTTED = frozenset({"os.replace", "os.rename"})
+
+
+@dataclasses.dataclass
+class FaultConfig:
+    """Project knowledge the pure-AST rules cannot derive on their own."""
+
+    select: frozenset = None
+    ignore: frozenset = frozenset()
+    # where chaos drills live; None auto-discovers the tests/ directory
+    # beside the registry module's package, an explicit tuple (possibly
+    # empty) overrides — fixtures pass () to stay hermetic
+    drill_paths: tuple = None
+    # acquire name -> names that count as its release
+    resource_pairs: tuple = (
+        ("alloc", ("release",)),
+        ("pin_manifest", ("release",)),
+        ("Popen", ("kill", "terminate", "wait", "communicate")),
+        ("ZerostallSaveHandle", ("wait",)),
+    )
+    # enclosing-function names that make FT06 treat an except handler as
+    # recovery code
+    recovery_fn_re: str = r"precheck|restore|resume|recover|fallback"
+    # a handler call whose terminal name matches this counts as
+    # reporting the swallowed exception
+    recovery_report_re: str = (
+        r"quarantine\w*|emit|warn\w*|log\w*|error|exception|record\w*|fail\w*"
+    )
+    # FT02 call-graph search depth from an effect chain to its seam
+    seam_depth: int = 3
+    # registry sites whose kind is exempt from FT04 (bookkeeping seams —
+    # nothing kills or raises there)
+    drill_exempt_kinds: frozenset = frozenset({"counter"})
+    # shared with callgraph.resolve_call
+    fuzzy_method_blacklist: frozenset = frozenset(
+        {"get", "put", "pop", "add", "close", "start", "stop", "flush",
+         "log", "read", "write", "items", "keys", "values", "append",
+         "extend", "update", "join", "wait", "copy", "clear", "emit",
+         "reset", "send", "next", "run", "replace", "rename", "unlink",
+         "release", "check"}
+    )
+
+    def rule_enabled(self, name, rule_id):
+        if name in self.ignore or rule_id in self.ignore:
+            return False
+        if self.select is None:
+            return True
+        return name in self.select or rule_id in self.select
+
+
+DEFAULT_FAULT_CONFIG = FaultConfig()
+
+
+@dataclasses.dataclass
+class Event:
+    kind: str  # stage | write | fsync | publish | unlink
+    module: object
+    node: object
+    line: int
+    what: str  # rendered callee, for messages and --list-sites
+    in_loop: bool = False
+    in_cleanup: bool = False  # inside a Try finalbody / except handler
+
+
+@dataclasses.dataclass
+class EffectChain:
+    """All durability events of one outermost function, in line order."""
+
+    module: object
+    fn: object  # outermost FunctionInfo, or None for module level
+    events: list
+
+    @property
+    def publishes(self):
+        return [e for e in self.events if e.kind == PUBLISH]
+
+    @property
+    def staged(self):
+        return [e for e in self.events if e.kind in (STAGE, WRITE)]
+
+    @property
+    def fsyncs(self):
+        return [e for e in self.events if e.kind == FSYNC]
+
+    @property
+    def loop_unlinks(self):
+        return [
+            e for e in self.events
+            if e.kind == UNLINK and e.in_loop and not e.in_cleanup
+        ]
+
+    def label(self):
+        return self.fn.qualname if self.fn is not None else "<module>"
+
+
+@dataclasses.dataclass
+class Seam:
+    module: object
+    node: object
+    site: str  # literal site string, or None when dynamic
+    fn: object  # innermost enclosing FunctionInfo (None at module level)
+
+
+@dataclasses.dataclass
+class RegistryEntry:
+    site: str
+    line: int
+    owner: str  # declared owning module
+    kind: str
+    drill: str
+
+
+@dataclasses.dataclass
+class DrillRef:
+    module: object
+    node: object
+    ftype: str
+    sites: frozenset  # sites this plan spec can fire
+
+
+@dataclasses.dataclass
+class Acquire:
+    module: object
+    node: object  # the acquiring Call
+    name: str  # resource-pair key (alloc / pin_manifest / ...)
+    target: str  # bound variable name, or None
+    base: str  # dotted receiver of the acquire call ("self.pool"), or None
+    fn: object  # enclosing function NODE (ast), or None
+    protected: bool
+    why: str  # how it is protected / handed off, for --list-sites
+    leak_raise: object  # the escaping Raise node, when unprotected
+
+
+def _terminal_name(func):
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _receiver_dotted(func):
+    """Dotted receiver of an Attribute callee ('self.pool' for
+    ``self.pool.alloc``), else None."""
+    if isinstance(func, ast.Attribute):
+        return dotted_name(func.value)
+    return None
+
+
+class FaultModel:
+    def __init__(self, modules, config=None):
+        self.config = config or DEFAULT_FAULT_CONFIG
+        self.modules = list(modules)
+        self.index = ProjectIndex(self.modules)
+        self.seams = []
+        self.chains = []
+        self.acquires = []
+        self.recovery_handlers = []  # (module, fn_node, handler)
+        self.registry = {}  # site -> RegistryEntry
+        self.registry_module = None
+        self.fault_types = {}  # type_name -> {"sites": [...], "ops": {...}}
+        self.drill_refs = []
+        self.drill_modules = []
+        self._seam_fns = set()  # FunctionInfo lexically containing a seam
+        for m in self.modules:
+            self._extract_registry(m)
+        for m in self.modules:
+            self._extract_module(m)
+        self._load_drill_corpus()
+        for m in self.drill_modules:
+            self._extract_drill_refs(m)
+
+    # ---- registry + fault-type declarations --------------------------------
+
+    def _extract_registry(self, module):
+        for node in module.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == REGISTRY_NAME
+                and isinstance(node.value, ast.Dict)
+            ):
+                self.registry_module = module
+                for k, v in zip(node.value.keys, node.value.values):
+                    if not (isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)):
+                        continue
+                    meta = {}
+                    if isinstance(v, ast.Dict):
+                        for mk, mv in zip(v.keys, v.values):
+                            if (isinstance(mk, ast.Constant)
+                                    and isinstance(mv, ast.Constant)):
+                                meta[mk.value] = mv.value
+                    self.registry[k.value] = RegistryEntry(
+                        site=k.value, line=k.lineno,
+                        owner=str(meta.get("module", "")),
+                        kind=str(meta.get("kind", "")),
+                        drill=str(meta.get("drill", "")),
+                    )
+        # fault classes: type_name / sites / _OPS class attributes
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            tname, sites, ops = None, [], {}
+            for stmt in node.body:
+                if not (isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)):
+                    continue
+                name, val = stmt.targets[0].id, stmt.value
+                if name == "type_name" and isinstance(val, ast.Constant):
+                    tname = val.value
+                elif name == "sites" and isinstance(val, (ast.Tuple,
+                                                          ast.List)):
+                    sites = [
+                        e.value for e in val.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    ]
+                elif name == "_OPS" and isinstance(val, ast.Dict):
+                    for k, v in zip(val.keys, val.values):
+                        if isinstance(k, ast.Constant) and isinstance(
+                            v, ast.Constant
+                        ):
+                            ops[k.value] = v.value
+            if tname:
+                self.fault_types[tname] = {"sites": sites, "ops": ops}
+
+    @property
+    def registry_armed(self):
+        return self.registry_module is not None
+
+    # ---- per-module extraction ---------------------------------------------
+
+    def _extract_module(self, module):
+        rx_recovery = _compiled(self.config.recovery_fn_re)
+        pairs = dict(self.config.resource_pairs)
+        events_by_group = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                seam = self._seam_of(module, node)
+                if seam is not None:
+                    self.seams.append(seam)
+                    self._note_seam_fns(seam)
+                    continue
+                ev = self._event_of(module, node)
+                if ev is not None:
+                    key = self._outermost(module, node)
+                    events_by_group.setdefault(key, []).append(ev)
+                if _terminal_name(node.func) in pairs:
+                    acq = self._acquire_of(module, node, pairs)
+                    if acq is not None:
+                        self.acquires.append(acq)
+            elif isinstance(node, ast.ExceptHandler):
+                fn = module.enclosing_function(node)
+                if fn is not None and rx_recovery.search(fn.name):
+                    self.recovery_handlers.append((module, fn, node))
+            elif isinstance(node, ast.Dict):
+                ref = self._drill_ref_of(module, node)
+                if ref is not None:
+                    self.drill_refs.append(ref)
+        for fn, events in events_by_group.items():
+            events.sort(key=lambda e: e.line)
+            self.chains.append(EffectChain(module, fn, events))
+        self.chains.sort(
+            key=lambda c: (c.module.relpath,
+                           c.events[0].line if c.events else 0)
+        )
+
+    # ---- seams -------------------------------------------------------------
+
+    def _seam_of(self, module, call):
+        d = dotted_name(call.func)
+        is_seam = d is not None and (
+            d == "faults.check" or d.endswith(".faults.check")
+        )
+        if not is_seam and isinstance(call.func, ast.Name) and \
+                call.func.id == "check":
+            imp = self.index.from_imports[module].get("check")
+            is_seam = imp is not None and imp[0].endswith("faults")
+        if not is_seam:
+            return None
+        site = None
+        if call.args and isinstance(call.args[0], ast.Constant) and \
+                isinstance(call.args[0].value, str):
+            site = call.args[0].value
+        fn_node = module.enclosing_function(call)
+        fn = self.index.by_node.get(fn_node) if fn_node is not None else None
+        return Seam(module, call, site, fn)
+
+    def _note_seam_fns(self, seam):
+        fn = seam.fn
+        while fn is not None:
+            self._seam_fns.add(fn)
+            fn = fn.parent
+
+    # ---- durable-effect events ---------------------------------------------
+
+    def _event_of(self, module, call):
+        d = dotted_name(call.func)
+        kind, what = None, d or ""
+        attr = _terminal_name(call.func)
+        if d in _PUBLISH_DOTTED:
+            kind = PUBLISH
+        elif d in _UNLINK_DOTTED:
+            kind = UNLINK
+        elif d == "os.fsync" or (isinstance(call.func, ast.Name)
+                                 and call.func.id == "fsync"):
+            kind, what = FSYNC, "os.fsync"
+        elif d is not None and (d == "tempfile.mkstemp"
+                                or d.endswith(".mkstemp")) or (
+            isinstance(call.func, ast.Name) and call.func.id == "mkstemp"
+        ):
+            kind, what = STAGE, "mkstemp"
+        elif isinstance(call.func, ast.Attribute):
+            if attr in _WRITE_ATTRS:
+                kind, what = WRITE, f".{attr}"
+            elif attr == "unlink":
+                kind, what = UNLINK, ".unlink"
+            elif (
+                attr in ("replace", "rename")
+                and len(call.args) == 1
+                and isinstance(module.parents.get(call), ast.Expr)
+            ):
+                # Path.replace(target)/Path.rename(target) take one
+                # argument and are called for effect (result discarded);
+                # str.replace(old, new) takes two, and
+                # dataclasses.replace(obj, **kw) returns a value the
+                # caller consumes — both arms discriminate
+                kind, what = PUBLISH, f".{attr}"
+        if kind is None:
+            return None
+        in_loop = in_cleanup = False
+        prev = call
+        for anc in module.ancestors(call):
+            if isinstance(anc, (ast.For, ast.While)):
+                in_loop = True
+            elif isinstance(anc, ast.Try):
+                if any(prev is n or _contains(n, prev)
+                       for n in anc.finalbody) or any(
+                    prev is h or _contains(h, prev) for h in anc.handlers
+                ):
+                    in_cleanup = True
+            elif isinstance(anc, ast.ExceptHandler):
+                in_cleanup = True
+            elif isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                pass  # folding into the outermost group crosses defs
+            prev = anc
+        return Event(
+            kind=kind, module=module, node=call, line=call.lineno,
+            what=what, in_loop=in_loop, in_cleanup=in_cleanup,
+        )
+
+    def _outermost(self, module, node):
+        fn_node = module.enclosing_function(node)
+        if fn_node is None:
+            return None
+        fi = self.index.by_node.get(fn_node)
+        while fi is not None and fi.parent is not None:
+            fi = fi.parent
+        return fi
+
+    # ---- drills ------------------------------------------------------------
+
+    def _drill_ref_of(self, module, dnode):
+        keys = {}
+        for k, v in zip(dnode.keys, dnode.values):
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                keys[k.value] = v
+        tnode = keys.get("type")
+        if not (isinstance(tnode, ast.Constant)
+                and isinstance(tnode.value, str)):
+            return None
+        ftype = tnode.value
+        decl = self.fault_types.get(ftype)
+        sites = set()
+        snode = keys.get("site")
+        onode = keys.get("op")
+        if isinstance(snode, ast.Constant) and isinstance(snode.value, str):
+            sites = {snode.value}
+        elif snode is not None:
+            # dynamic site (the zerostall stage loop): any declared site
+            sites = set(decl["sites"]) if decl else set()
+        elif isinstance(onode, ast.Constant) and decl:
+            mapped = decl["ops"].get(onode.value)
+            sites = {mapped} if mapped else set(decl["sites"])
+        elif decl:
+            if ftype == "kill9_during_save":
+                # no explicit site defaults to the first declared one
+                sites = set(decl["sites"][:1])
+            else:
+                sites = set(decl["sites"])
+        return DrillRef(module, dnode, ftype, frozenset(sites))
+
+    def _load_drill_corpus(self):
+        paths = self.config.drill_paths
+        if paths is None:
+            if self.registry_module is None:
+                return
+            try:
+                root = Path(self.registry_module.path).resolve().parents[2]
+            except (IndexError, OSError):
+                return
+            tests = root / "tests"
+            if not tests.is_dir():
+                return
+            paths = (tests,)
+        scanned = {str(Path(m.path).resolve()) for m in self.modules}
+        mods, _pre = _load_modules(paths, tool="faultcheck",
+                                   error_id="FT00")
+        self.drill_modules = [
+            m for m in mods if str(Path(m.path).resolve()) not in scanned
+        ]
+
+    def _extract_drill_refs(self, module):
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Dict):
+                ref = self._drill_ref_of(module, node)
+                if ref is not None:
+                    self.drill_refs.append(ref)
+
+    @property
+    def drills_armed(self):
+        return self.registry_armed and (
+            self.config.drill_paths is not None
+            or bool(self.drill_modules)
+            or bool(self.drill_refs)
+        )
+
+    def drilled_sites(self):
+        out = set()
+        for ref in self.drill_refs:
+            out |= ref.sites
+        return out
+
+    # ---- resources (FT05) --------------------------------------------------
+
+    def _acquire_of(self, module, call, pairs):
+        name = _terminal_name(call.func)
+        releases = pairs[name]
+        fn = module.enclosing_function(call)
+        target, assigned_attr = None, False
+        stmt = call
+        for anc in module.ancestors(call):
+            if isinstance(anc, ast.withitem) or isinstance(anc, ast.With):
+                return Acquire(module, call, name, None, None, fn,
+                               True, "with-statement", None)
+            if isinstance(anc, ast.Assign) and anc.value is stmt:
+                t = anc.targets[0]
+                if isinstance(t, ast.Name):
+                    target = t.id
+                elif isinstance(t, ast.Attribute):
+                    assigned_attr = True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+            stmt = anc
+        if assigned_attr:
+            # stored on an object — its lifetime outlives this function
+            return Acquire(module, call, name, None, None, fn,
+                           True, "stored-on-attribute", None)
+        base = _receiver_dotted(call.func)
+        scope = fn if fn is not None else module.tree
+        release_lines, protected_release = [], False
+        returned = False
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Call):
+                t = _terminal_name(node.func)
+                if t in releases and self._release_matches(
+                    node, target, base
+                ):
+                    release_lines.append(node.lineno)
+                    if self._in_cleanup(module, node, scope):
+                        protected_release = True
+            elif isinstance(node, ast.Return) and node.value is not None:
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Name) and sub.id == target and \
+                            target is not None:
+                        returned = True
+        if returned:
+            return Acquire(module, call, name, target, base, fn,
+                           True, "returned (handoff)", None)
+        if protected_release:
+            return Acquire(module, call, name, target, base, fn,
+                           True, "release-in-finally/handler", None)
+        first_release = min(release_lines) if release_lines else None
+        leak = None
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Raise):
+                continue
+            if module.enclosing_function(node) is not (
+                fn if fn is not None else None
+            ):
+                continue  # raises inside nested defs are not this path
+            if node.lineno <= call.lineno:
+                continue
+            if first_release is not None and node.lineno >= first_release:
+                continue
+            leak = node
+            break
+        return Acquire(module, call, name, target, base, fn,
+                       leak is None, "releases-before-any-raise", leak)
+
+    @staticmethod
+    def _release_matches(call, target, base):
+        recv = _receiver_dotted(call.func)
+        if recv is None:
+            return False
+        if target is not None and recv == target:
+            return True
+        if base is not None and recv == base:
+            return True
+        return False
+
+    def _in_cleanup(self, module, node, scope):
+        prev = node
+        for anc in module.ancestors(node):
+            if isinstance(anc, ast.Try):
+                if any(prev is n or _contains(n, prev)
+                       for n in anc.finalbody):
+                    return True
+            if isinstance(anc, ast.ExceptHandler):
+                return True
+            if anc is scope:
+                break
+            prev = anc
+        return False
+
+    # ---- seam reachability (FT02) ------------------------------------------
+
+    def seam_reachable(self, chain):
+        """True when a ``faults.check`` seam is lexically inside the
+        chain's outermost function or reachable from it through the
+        call graph within ``config.seam_depth`` edges."""
+        start = chain.fn
+        if start is None:
+            return any(
+                s.module is chain.module and s.fn is None
+                for s in self.seams
+            )
+        frontier, seen = [start], {start}
+        for _ in range(self.config.seam_depth + 1):
+            nxt = []
+            for fn in frontier:
+                if fn in self._seam_fns:
+                    return True
+                for node in ast.walk(fn.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    target = self.index.resolve_call(
+                        fn.module, node, self.config
+                    )
+                    if target is not None and target not in seen:
+                        seen.add(target)
+                        nxt.append(target)
+            if not nxt:
+                return False
+            frontier = nxt
+        return False
+
+    # ---- machine-readable dump (--list-sites) ------------------------------
+
+    def as_json_dict(self):
+        seams_by_site = {}
+        for s in self.seams:
+            seams_by_site.setdefault(s.site or "<dynamic>", []).append(
+                f"{s.module.relpath}:{s.node.lineno}"
+            )
+        drilled = self.drilled_sites()
+        sites = {}
+        for site, entry in sorted(self.registry.items()):
+            sites[site] = {
+                "module": entry.owner,
+                "kind": entry.kind,
+                "drill": entry.drill,
+                "seams": seams_by_site.get(site, []),
+                "drilled": site in drilled,
+            }
+        return {
+            "registry": {
+                "path": (self.registry_module.relpath
+                         if self.registry_module else None),
+                "sites": sites,
+            },
+            "seams": [
+                {
+                    "site": s.site,
+                    "where": f"{s.module.relpath}:{s.node.lineno}",
+                    "function": s.fn.qualname if s.fn else "<module>",
+                }
+                for s in self.seams
+            ],
+            "effect_chains": [
+                {
+                    "where": c.module.relpath,
+                    "function": c.label(),
+                    "events": [
+                        {"kind": e.kind, "line": e.line, "what": e.what}
+                        for e in c.events
+                    ],
+                    "seam_reachable": self.seam_reachable(c),
+                }
+                for c in self.chains
+            ],
+            "drills": [
+                {
+                    "type": r.ftype,
+                    "where": f"{r.module.relpath}:{r.node.lineno}",
+                    "sites": sorted(r.sites),
+                }
+                for r in self.drill_refs
+            ],
+            "resources": [
+                {
+                    "acquire": a.name,
+                    "where": f"{a.module.relpath}:{a.node.lineno}",
+                    "target": a.target,
+                    "protected": a.protected,
+                    "why": a.why,
+                }
+                for a in self.acquires
+            ],
+            "drill_corpus_files": len(self.drill_modules),
+        }
+
+
+def _contains(root, node):
+    if root is node:
+        return True
+    for sub in ast.walk(root):
+        if sub is node:
+            return True
+    return False
+
+
+_RX_CACHE = {}
+
+
+def _compiled(pattern):
+    rx = _RX_CACHE.get(pattern)
+    if rx is None:
+        import re
+
+        rx = _RX_CACHE[pattern] = re.compile(pattern)
+    return rx

@@ -1,0 +1,310 @@
+"""The FT rule catalog: six checks over the durability triangle.
+
+Durable effects (tmp→fsync→rename chains, GC unlinks, retention
+deletes), the fault seams that make them killable (``faults.check``
+sites + the declarative ``FAULT_SITES`` registry), and the chaos drills
+that actually kill them must agree; each FT rule checks one edge:
+
+* **FT01 publish-before-durability** — a rename publish whose staged
+  payload has no ``os.fsync`` *ordered before it* in the same effect
+  chain: a crash after the rename can expose a published file whose
+  bytes never reached the platter. Deeper than jaxlint's JX10, which
+  only requires an fsync to exist somewhere in the function.
+* **FT02 unseamed-durable-effect** — an effect chain with no
+  ``faults.check`` seam lexically inside it or reachable through the
+  call graph: the chaos harness structurally cannot kill there, so the
+  crash-consistency claim is untested for that writer.
+* **FT03 seam-drift** — a live seam names a site absent from the
+  ``FAULT_SITES`` registry (it can never fire), or a registry entry no
+  seam ever calls (documentation for a retired seam). The obscheck
+  OB01/OB02 triangle applied to faults.
+* **FT04 undrilled-seam** — a registered, non-bookkeeping site that no
+  chaos preset or kill-site test plan ever fires.
+* **FT05 leak-on-error** — a paired resource acquire (pool blocks, pin
+  leases, subprocesses, save handles) with an explicit raise between
+  the acquire and its first release, and no ``with``, finally/handler
+  release, or handoff protecting it.
+* **FT06 recovery-swallow** — an except handler inside recovery code
+  (precheck/restore/resume/recover/fallback functions) that neither
+  re-raises, quarantines, nor emits: a corrupt artifact heals itself
+  into silence.
+
+FT01/FT02 stand down for functions marked ``# faultcheck: tear-ok``
+(advisory artifacts — caches, rotating logs — where torn or unsynced
+bytes are acceptable by design). FT03/FT04 arm only when the registry
+module is part of the scan; FT04 additionally needs a drill corpus (the
+auto-discovered ``tests/`` directory, an explicit ``drill_paths``, or a
+plan literal in the scan) — see ``model.py``.
+"""
+
+import dataclasses
+
+from pyrecover_tpu.analysis.engine import (
+    Finding,
+    ModuleInfo,
+    _load_modules,
+)
+from pyrecover_tpu.analysis.faultcheck.model import (
+    DEFAULT_FAULT_CONFIG,
+    FaultModel,
+    _compiled,
+)
+
+FT_RULES = {}
+
+
+@dataclasses.dataclass
+class Rule:
+    id: str
+    name: str
+    severity: str
+    summary: str
+    check: object
+
+
+def rule(rule_id, name, severity, summary):
+    def register(fn):
+        FT_RULES[name] = Rule(rule_id, name, severity, summary, fn)
+        return fn
+
+    return register
+
+
+def finding(r, module, node, message):
+    return Finding(
+        rule=r.name,
+        rule_id=r.id,
+        severity=r.severity,
+        path=module.relpath,
+        line=getattr(node, "lineno", 1),
+        col=getattr(node, "col_offset", 0) + 1,
+        message=message,
+    )
+
+
+def _raw_finding(r, path, line, message):
+    return Finding(
+        rule=r.name, rule_id=r.id, severity=r.severity,
+        path=path, line=line, col=1, message=message,
+    )
+
+
+def _tear_ok(chain):
+    fn = chain.fn
+    while fn is not None:
+        if "tear-ok" in fn.markers:
+            return True
+        fn = fn.parent
+    return False
+
+
+@rule(
+    "FT01", "publish-before-durability", "error",
+    "rename publish with no fsync ordered before it",
+)
+def check_publish_durability(model, config):
+    r = FT_RULES["publish-before-durability"]
+    for chain in model.chains:
+        if not chain.publishes or _tear_ok(chain):
+            continue
+        staged = chain.staged
+        if not staged:
+            continue  # pure-rename chain: no payload staged here
+        fsync_lines = [e.line for e in chain.fsyncs]
+        for pub in chain.publishes:
+            if any(ln < pub.line for ln in fsync_lines):
+                continue
+            if not any(e.line < pub.line for e in staged):
+                continue  # this publish precedes any staging
+            yield finding(
+                r, chain.module, pub.node,
+                f"`{chain.label()}` publishes via {pub.what} with no "
+                f"os.fsync ordered before it — a crash after the rename "
+                f"can expose a file whose bytes never became durable "
+                f"(mark `# faultcheck: tear-ok` if the artifact is "
+                f"advisory)",
+            )
+
+
+@rule(
+    "FT02", "unseamed-durable-effect", "error",
+    "durable-effect chain with no faults.check seam reachable",
+)
+def check_unseamed_effect(model, config):
+    r = FT_RULES["unseamed-durable-effect"]
+    for chain in model.chains:
+        effects = chain.publishes + chain.loop_unlinks
+        if not effects or _tear_ok(chain):
+            continue
+        if model.seam_reachable(chain):
+            continue
+        first = min(effects, key=lambda e: e.line)
+        kinds = sorted({e.kind for e in effects})
+        yield finding(
+            r, chain.module, first.node,
+            f"`{chain.label()}` has durable effects ({', '.join(kinds)}) "
+            f"but no faults.check seam on its path — the chaos harness "
+            f"cannot kill this writer (mark `# faultcheck: tear-ok` if "
+            f"the artifact is advisory)",
+        )
+
+
+@rule(
+    "FT03", "seam-drift", "error",
+    "live seam site absent from FAULT_SITES, or registry entry no seam calls",
+)
+def check_seam_drift(model, config):
+    if not model.registry_armed:
+        return
+    r = FT_RULES["seam-drift"]
+    live = {s.site for s in model.seams if s.site is not None}
+    for s in model.seams:
+        if s.site is None or s.site in model.registry:
+            continue
+        yield finding(
+            r, s.module, s.node,
+            f'faults.check("{s.site}") names a site that is not in the '
+            f"FAULT_SITES registry — no plan can ever fire it, and with "
+            f"a plan active the seam itself raises FaultPlanError",
+        )
+    for site, entry in model.registry.items():
+        if site in live:
+            continue
+        yield _raw_finding(
+            r, model.registry_module.relpath, entry.line,
+            f'FAULT_SITES registers "{site}" but no faults.check seam '
+            f"calls it (renamed or retired?)",
+        )
+
+
+@rule(
+    "FT04", "undrilled-seam", "warning",
+    "registered site no chaos preset or kill-site test ever fires",
+)
+def check_undrilled_seam(model, config):
+    if not model.drills_armed:
+        return
+    r = FT_RULES["undrilled-seam"]
+    drilled = model.drilled_sites()
+    for site, entry in model.registry.items():
+        if entry.kind in config.drill_exempt_kinds:
+            continue
+        if site in drilled:
+            continue
+        yield _raw_finding(
+            r, model.registry_module.relpath, entry.line,
+            f'registered site "{site}" is fired by no chaos preset or '
+            f"test plan — the seam exists but the failure it guards is "
+            f"never rehearsed",
+        )
+
+
+@rule(
+    "FT05", "leak-on-error", "error",
+    "acquire with a raise path escaping before its release",
+)
+def check_leak_on_error(model, config):
+    r = FT_RULES["leak-on-error"]
+    for a in model.acquires:
+        if a.protected:
+            continue
+        yield finding(
+            r, a.module, a.node,
+            f"`{a.name}` acquired here leaks when the raise at line "
+            f"{a.leak_raise.lineno} escapes — release it in a finally/"
+            f"except, use a with-statement, or hand the handle off",
+        )
+
+
+@rule(
+    "FT06", "recovery-swallow", "warning",
+    "recovery-path handler neither re-raises, quarantines, nor emits",
+)
+def check_recovery_swallow(model, config):
+    import ast
+
+    r = FT_RULES["recovery-swallow"]
+    report_rx = _compiled(rf"^({config.recovery_report_re})$")
+    for module, fn, handler in model.recovery_handlers:
+        ok = False
+        for node in ast.walk(handler):
+            # returning from the handler routes the failure to the
+            # caller as a verdict (the precheck `return False, why`
+            # protocol) — that is reporting, not swallowing
+            if isinstance(node, (ast.Raise, ast.Return)):
+                ok = True
+                break
+            if isinstance(node, ast.Call):
+                name = None
+                if isinstance(node.func, ast.Attribute):
+                    name = node.func.attr
+                elif isinstance(node.func, ast.Name):
+                    name = node.func.id
+                if name is not None and report_rx.match(name):
+                    ok = True
+                    break
+        if ok:
+            continue
+        what = [
+            getattr(t, "id", getattr(t, "attr", "?"))
+            for t in ([handler.type] if handler.type is not None else [])
+        ]
+        yield finding(
+            r, module, handler,
+            f"recovery function `{fn.name}` swallows "
+            f"{'/'.join(what) or 'a bare except'} without re-raising, "
+            f"quarantining, or emitting — a corrupt artifact heals "
+            f"itself into silence",
+        )
+
+
+@dataclasses.dataclass
+class FaultResult:
+    findings: list
+    files_scanned: int
+
+    @property
+    def unsuppressed(self):
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def suppressed(self):
+        return [f for f in self.findings if f.suppressed]
+
+
+def analyze_modules(modules, config=None, pre_findings=()):
+    config = config or DEFAULT_FAULT_CONFIG
+    model = FaultModel(modules, config)
+    by_path = {m.relpath: m for m in modules}
+    findings = list(pre_findings)
+    for r in FT_RULES.values():
+        if not config.rule_enabled(r.name, r.id):
+            continue
+        findings.extend(r.check(model, config))
+    for f in findings:
+        module = by_path.get(f.path)
+        if module is not None:
+            f.suppressed, f.justification = module.suppression_for(
+                f.rule, f.rule_id, f.line
+            )
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule_id))
+    return FaultResult(
+        findings=findings, files_scanned=len(modules) + len(pre_findings)
+    )
+
+
+def analyze_paths(paths, config=None):
+    modules, pre = _load_modules(paths, tool="faultcheck", error_id="FT00")
+    return analyze_modules(modules, config, pre_findings=pre)
+
+
+def analyze_source(source, name="<snippet>", config=None):
+    module = ModuleInfo(name, source, relpath=name, tool="faultcheck")
+    return analyze_modules([module], config)
+
+
+def build_model(paths, config=None):
+    """The extracted durability model for ``--list-sites`` and the test
+    suite (no rules run)."""
+    modules, _pre = _load_modules(paths, tool="faultcheck", error_id="FT00")
+    return FaultModel(modules, config or DEFAULT_FAULT_CONFIG)

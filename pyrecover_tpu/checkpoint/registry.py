@@ -131,7 +131,13 @@ def prune_checkpoints(exp_dir, max_keep, *, sharded=None, engine=None):
     ckpts = list_checkpoints(exp_dir, engine=want)
     doomed = ckpts[:-max_keep] if len(ckpts) > max_keep else []
     engine_label = want or "any"
+    from pyrecover_tpu.resilience import faults
+
     for p in doomed:
+        # seam BEFORE the deletion: retention destroys durable state, so
+        # a drill must be able to kill between victim selection and the
+        # rmtree/unlink to prove a half-finished prune stays restorable
+        faults.check("ckpt_prune", path=p.name, step=parse_step(p))
         if p.is_dir():
             shutil.rmtree(p, ignore_errors=True)
         else:

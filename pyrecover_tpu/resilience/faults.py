@@ -20,24 +20,13 @@ Plan format (JSON — inline in ``$PYRECOVER_FAULT_PLAN`` or a file path)::
         {"type": "metadata_flap", "fail_count": 3, "after_ok": 2}
     ]}
 
-Injection sites (``check(site, **ctx)`` seams placed in production code):
-
-    train_step        train.py hot loop   ctx: step (the step about to run)
-    ckpt_save_begin   every engine's save ctx: engine, path (bumps save index)
-    ckpt_write        vanilla stream / native_io write   ctx: path, written
-    ckpt_fsync        vanilla stream pre-publish         ctx: path
-    ckpt_rename       vanilla atomic publish             ctx: path
-    ckpt_commit       after a save is durable            ctx: engine, path
-    ckpt_read         vanilla/native/chunk read path     ctx: path
-    ckpt_snapshot     zerostall device→host snapshot     ctx: path, leaves
-    ckpt_chunk_write  zerostall chunk store write        ctx: path, written
-    ckpt_manifest_commit  zerostall durable-but-unpublished manifest  ctx: path
-    swap_fetch        serving hot-swap incremental chunk fetch  ctx: path,
-                      written (bytes fetched so far — the chaos drill's
-                      kill-mid-swap site; save_index 0 targets a process
-                      that never saves, e.g. a serving replica)
-    loader_batch      data loader batch materialization  ctx: batch
-    metadata_poll     maintenance watcher poll loop      ctx: base
+Injection sites are declared in :data:`FAULT_SITES` below — the single
+source of truth for which seams exist, who owns them, and which drill
+fires them. ``faults.check`` (with a plan active) and plan installation
+both validate against it, so a typo'd site string raises
+:class:`FaultPlanError` naming the known sites instead of silently never
+firing; ``tools/faultcheck.py`` reads the same registry statically to
+prove every durable effect sits behind a registered, drilled seam.
 
 With no plan active, ``check`` is rebound to a no-op — seams cost one
 attribute lookup and an empty call. The first ``check`` after import
@@ -56,6 +45,97 @@ import time
 from pyrecover_tpu import telemetry
 
 PLAN_ENV = "PYRECOVER_FAULT_PLAN"
+
+# The declarative seam registry: every ``check(site, **ctx)`` site in
+# production code, its owning module, what KIND of effect the seam
+# guards, and the drill that fires it. This is a *contract surface*:
+# ``faults.check`` and ``FaultEngine`` validate live site strings
+# against it (an unknown site raises loudly instead of silently never
+# firing), faultcheck's FT03/FT04 rules cross-check it statically
+# against the seam call sites and the chaos-drill plan corpus, and the
+# test suite pins both directions. ``kind: "counter"`` marks a
+# bookkeeping seam (it only advances the save index — nothing kills or
+# raises there), which FT04 exempts from drill coverage.
+FAULT_SITES = {
+    "train_step": {
+        "module": "train.py", "kind": "step",
+        "drill": "chaos sigterm/random_sigkill cycles; ctx: step",
+    },
+    "ckpt_save_begin": {
+        "module": "checkpoint/*", "kind": "counter",
+        "drill": "bumps the save index save-indexed faults key on; "
+                 "ctx: engine, path",
+    },
+    "ckpt_write": {
+        "module": "checkpoint/vanilla.py, checkpoint/native_io.py",
+        "kind": "write",
+        "drill": "chaos kill9_during_save (default site) + "
+                 "transient_io_error op=write; ctx: path, written",
+    },
+    "ckpt_fsync": {
+        "module": "checkpoint/vanilla.py", "kind": "fsync",
+        "drill": "transient_io_error op=fsync (retry-path test); "
+                 "ctx: path",
+    },
+    "ckpt_rename": {
+        "module": "checkpoint/vanilla.py", "kind": "publish",
+        "drill": "transient_io_error op=rename (chaos cycle 3 + retry "
+                 "tests); ctx: path",
+    },
+    "ckpt_commit": {
+        "module": "checkpoint/vanilla.py", "kind": "commit",
+        "drill": "chaos corrupt_ckpt_bytes cycle; ctx: engine, path",
+    },
+    "ckpt_read": {
+        "module": "checkpoint/{vanilla,native_io}.py, zerostall "
+                  "chunkstore", "kind": "read",
+        "drill": "transient_io_error op=read (restore retry tests); "
+                 "ctx: path",
+    },
+    "ckpt_snapshot": {
+        "module": "checkpoint/zerostall/snapshot.py", "kind": "snapshot",
+        "drill": "chaos zerostall kill9_during_save site=ckpt_snapshot; "
+                 "ctx: path, leaves",
+    },
+    "ckpt_chunk_write": {
+        "module": "checkpoint/zerostall/chunkstore.py", "kind": "write",
+        "drill": "chaos zerostall kill9_during_save "
+                 "site=ckpt_chunk_write + transient_io_error "
+                 "op=chunk_write; ctx: path, written",
+    },
+    "ckpt_manifest_commit": {
+        "module": "checkpoint/zerostall/chunkstore.py", "kind": "publish",
+        "drill": "chaos zerostall kill9_during_save "
+                 "site=ckpt_manifest_commit + transient_io_error "
+                 "op=manifest_commit; ctx: path",
+    },
+    "ckpt_gc_unlink": {
+        "module": "checkpoint/zerostall/{chunkstore,pins}.py",
+        "kind": "unlink",
+        "drill": "transient_io_error op=gc_unlink (GC sweep must heal "
+                 "and never over-collect); ctx: path",
+    },
+    "ckpt_prune": {
+        "module": "checkpoint/registry.py", "kind": "unlink",
+        "drill": "transient_io_error op=prune (retention sweep must "
+                 "leave survivors intact); ctx: path, step",
+    },
+    "swap_fetch": {
+        "module": "serving/hotswap/fetch.py", "kind": "fetch",
+        "drill": "hotswap chaos drill kill9_during_save site=swap_fetch "
+                 "save_index=0 (a serving replica never saves); "
+                 "ctx: path, written",
+    },
+    "loader_batch": {
+        "module": "data/loader.py", "kind": "stall",
+        "drill": "chaos hang drill loader_stall; ctx: batch",
+    },
+    "metadata_poll": {
+        "module": "resilience/maintenance.py", "kind": "poll",
+        "drill": "metadata_flap backoff/degrade/recover tests; "
+                 "ctx: base",
+    },
+}
 
 
 class FaultPlanError(ValueError):
@@ -285,12 +365,15 @@ class _TransientIOError(_Fault):
     ``fail_count`` raises — the retry/backoff path's proof load."""
 
     sites = ("ckpt_write", "ckpt_fsync", "ckpt_rename", "ckpt_read",
-             "ckpt_chunk_write", "ckpt_manifest_commit")
+             "ckpt_chunk_write", "ckpt_manifest_commit",
+             "ckpt_gc_unlink", "ckpt_prune")
     type_name = "transient_io_error"
     _OPS = {"write": "ckpt_write", "fsync": "ckpt_fsync",
             "rename": "ckpt_rename", "read": "ckpt_read",
             "chunk_write": "ckpt_chunk_write",
-            "manifest_commit": "ckpt_manifest_commit", "any": None}
+            "manifest_commit": "ckpt_manifest_commit",
+            "gc_unlink": "ckpt_gc_unlink", "prune": "ckpt_prune",
+            "any": None}
 
     def __init__(self, spec):
         super().__init__(spec)
@@ -372,6 +455,29 @@ _FAULT_TYPES = {
 }
 
 
+def _unknown_site_error(site, where):
+    return FaultPlanError(
+        f"unknown site {site!r} at {where}; known sites: "
+        f"{sorted(FAULT_SITES)}"
+    )
+
+
+def _validate_fault_types():
+    """Every site a fault class declares (or maps an op to) must be in
+    the registry — a drifted declaration would silently never fire, so
+    it fails at import instead."""
+    for cls in _FAULT_TYPES.values():
+        for site in cls.sites:
+            if site not in FAULT_SITES:
+                raise _unknown_site_error(site, f"{cls.type_name}.sites")
+    for op, site in _TransientIOError._OPS.items():
+        if site is not None and site not in FAULT_SITES:
+            raise _unknown_site_error(site, f"transient_io_error op {op!r}")
+
+
+_validate_fault_types()
+
+
 class FaultEngine:
     """The active plan: parsed fault list + the per-run save counter the
     save-indexed faults key on. One engine per process; sites funnel
@@ -392,12 +498,19 @@ class FaultEngine:
                     f"unknown fault type {ftype!r}; known: "
                     f"{sorted(_FAULT_TYPES)}"
                 )
+            site = spec.get("site")
+            if site is not None and site not in FAULT_SITES:
+                raise _unknown_site_error(site, f"{ftype} plan spec")
             try:
                 self.faults.append(cls(spec))
             except (KeyError, TypeError, ValueError) as e:
                 raise FaultPlanError(f"bad {ftype} spec {spec}: {e}") from e
 
     def check(self, site, **ctx):
+        if site not in FAULT_SITES:
+            # a seam naming an unregistered site would never match any
+            # plan — fail the run loudly instead of silently not injecting
+            raise _unknown_site_error(site, "a live check() seam")
         if site == "ckpt_save_begin":
             with self._lock:
                 self.save_index += 1

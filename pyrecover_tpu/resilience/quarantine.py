@@ -66,6 +66,10 @@ def quarantine_checkpoint(path, reason=""):
         # jaxlint: disable-next=torn-write -- a MOVE of already-committed
         # bytes: content durability was paid at save commit; fsync here would
         # re-pay it for a corpse
+        # faultcheck: disable-next=unseamed-durable-effect -- quarantine IS the
+        # failure path: it runs after a corrupt_ckpt_bytes drill detects
+        # damage, and seaming the mover would inject faults into fault
+        # handling itself; the whole move is retried on the next precheck
         os.replace(path, dest)
         if not dest.is_dir():  # vanilla file: bring its checksum sidecars
             for suffix in _SIDECAR_SUFFIXES:

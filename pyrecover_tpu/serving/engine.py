@@ -422,10 +422,12 @@ class ServingEngine:
         with self._lock:
             queued = len(self._waiting)
         g("serving_queued").set(queued)
-        # decode rate over a short sliding window of cumulative totals
+        # decode rate over a short sliding window of cumulative totals;
+        # always keep two samples so a starved pump (iterations slower
+        # than the window) still yields a rate instead of dt == 0
         window = self._tok_window
         window.append((now, self._tok_total))
-        while window and window[0][0] < now - 2.0:
+        while len(window) > 2 and window[0][0] < now - 2.0:
             window.pop(0)
         dt = now - window[0][0]
         if dt > 0:
@@ -469,14 +471,25 @@ class ServingEngine:
                     metrics.counter("serving_backpressure_total").inc()
                 return admitted
             req.blocks = self.pool.alloc(req.rid, need)
-            req.slot = free_slots[0]
-            req.state = PREFILL
-            req.t_admit = time.monotonic()
-            self._slots[req.slot] = req
-            self._tables[req.slot] = make_block_table(
-                self.table_width, req.blocks
-            )
-            self._prefill.append(req)
+            try:
+                req.slot = free_slots[0]
+                req.state = PREFILL
+                req.t_admit = time.monotonic()
+                self._slots[req.slot] = req
+                self._tables[req.slot] = make_block_table(
+                    self.table_width, req.blocks
+                )
+                self._prefill.append(req)
+            except BaseException:
+                # admission failed after the grant: hand the blocks back
+                # before propagating, or check_drained() reports a leak
+                # for a request that never ran
+                self.pool.release(req.rid)
+                req.blocks = None
+                if req.slot is not None and self._slots[req.slot] is req:
+                    self._slots[req.slot] = None
+                req.slot = None
+                raise
             telemetry.emit(
                 "request_admitted", rid=req.rid,
                 prompt_tokens=len(req.prompt),

@@ -174,7 +174,13 @@ class BlockPool:
             raise ValueError(f"sequence {key!r} already holds blocks")
         if n > len(self._free):
             return None
-        got = [self._free.pop() for _ in range(n)]
+        # grant atomically: take the tail slice, then commit both sides.
+        # A per-block pop loop would leave blocks stranded off the free
+        # list if anything raised mid-grant (a hostile list subclass, a
+        # KeyboardInterrupt) — "no partial grants" has to hold on the
+        # exception path too, not just the None path.
+        got = self._free[-n:][::-1]  # same order the old pop loop granted
+        del self._free[-n:]
         self._held[key] = got
         return got
 

@@ -241,6 +241,8 @@ class FlightRecorder:
         prev(args)
 
     # -- the dump ------------------------------------------------------------
+    # best-effort postmortem bundle; doctor tolerates a torn or absent
+    # dump  # faultcheck: tear-ok
     def dump(self, reason, *, exc=None, thread=None, **extra):
         # jaxlint: host-only
         """Write one postmortem bundle; returns its path (None if rate-

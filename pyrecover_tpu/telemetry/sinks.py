@@ -51,6 +51,9 @@ class JsonlSink:
     one continuous stream.
     """
 
+    # fresh-run shard sweep of advisory telemetry; a crash mid-sweep
+    # leaves stale shards the next sweep removes
+    # faultcheck: tear-ok
     def __init__(self, path, *, host0_only=True, append=True,
                  max_bytes=None, keep=None):
         self.path = Path(path)
@@ -74,7 +77,7 @@ class JsonlSink:
         if append and self.path.exists():
             self._bytes = self.path.stat().st_size
 
-    def _rotate(self):
+    def _rotate(self):  # faultcheck: tear-ok -- advisory log rotation
         self._file.close()
         self._file = None
         shards = rotated_paths(self.path)  # oldest first

@@ -459,6 +459,10 @@ def _resume(config, exp_dir, state, sampler, sharded_ckptr, totals):  # jaxlint:
                         )
                     if not ok:
                         verdict, reason = 0, why
+            # faultcheck: disable-next=recovery-swallow -- not a swallow:
+            # the handler folds the failure into the host-0 verdict that
+            # is broadcast and re-raised on EVERY host a few lines down
+            # (raising here directly would desynchronize the collective)
             except CheckpointStructureError as e:
                 verdict, reason = 2, str(e)
         verdict = int(broadcast_host0_scalar(verdict))

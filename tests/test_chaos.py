@@ -434,3 +434,55 @@ def test_chaos_smoke_soak_bitexact(tmp_path):
 
     assert ap["golden_intervals"] == [AP_CEILING]
     assert (tmp_path / "report.json").exists()
+
+
+# ---- site registry validation + the retry-path seams ------------------------
+
+def test_fault_sites_registry_shape():
+    """Every declarative FAULT_SITES entry documents its owner, kind,
+    and the drill that fires it — the contract faultcheck FT03/FT04
+    cross-check statically."""
+    assert faults.FAULT_SITES
+    for site, meta in faults.FAULT_SITES.items():
+        assert {"module", "kind", "drill"} <= set(meta), site
+
+
+def test_plan_spec_unknown_site_fails_naming_known_sites():
+    with pytest.raises(faults.FaultPlanError, match="unknown site") as ei:
+        faults.install({"faults": [
+            {"type": "transient_io_error", "site": "ckpt_nope"},
+        ]})
+    # the error teaches the registry instead of silently never firing
+    assert "ckpt_write" in str(ei.value)
+
+
+def test_live_seam_unknown_site_fails_loudly():
+    """A seam naming an unregistered site could never match any plan —
+    with an engine active it must fail the run, not silently skip
+    injection."""
+    faults.install({"faults": [{"type": "loader_stall", "seconds": 1}]})
+    with pytest.raises(faults.FaultPlanError, match="unknown site"):
+        faults.check("definitely_not_a_site")
+    faults.check("train_step", step=1)  # registered sites still flow
+
+
+def test_transient_fsync_and_read_heal_via_retry(tmp_path, mem_sink):
+    """The two retry-path seams the site registry documents but no test
+    drilled: an EIO at ckpt_fsync during a real vanilla save and at
+    ckpt_read during the load-back are both absorbed by io_retry."""
+    from pyrecover_tpu.checkpoint.vanilla import (
+        load_ckpt_vanilla,
+        save_ckpt_vanilla,
+    )
+
+    faults.install({"faults": [
+        {"type": "transient_io_error", "op": "fsync", "fail_count": 1},
+        {"type": "transient_io_error", "op": "read", "fail_count": 1},
+    ]})
+    path = tmp_path / "ckpt_1.ckpt"
+    state = tiny_state()
+    save_ckpt_vanilla(path, state, verify=True)
+    restored, _, _ = load_ckpt_vanilla(path, state, verify=True)
+    np.testing.assert_array_equal(restored["a"], state["a"])
+    retries = events(mem_sink, "ckpt_io_retry")
+    assert {e["op"] for e in retries} >= {"fsync", "read"}

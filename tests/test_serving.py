@@ -756,3 +756,65 @@ def test_generate_tokens_is_the_unchanged_lockstep_baseline(params):
     a = generate_tokens(params, CFG, prompts, 5)
     b = generate_tokens(params, CFG, prompts, 5)
     assert a == b and len(a) == 2 and all(len(s) == 8 for s in a)
+
+
+# ---- exception-path accounting: alloc grants and admission -------------
+
+
+def test_pool_alloc_raise_atomic_mid_grant():
+    """alloc is a slice-granted transaction, not a per-block pop loop:
+    an exception raised mid-grant must leave the free list and held map
+    exactly as they were — "no partial grants" holds on the exception
+    path too, and the grant order stays bit-identical to the old loop."""
+    pool = BlockPool(CFG, n_blocks=9, block_size=8)
+
+    class PopBomb(list):
+        # the old per-block pop loop died here, stranding blocks
+        def pop(self, *a):
+            raise KeyboardInterrupt
+
+    pool._free = PopBomb(pool._free)
+    got = pool.alloc("a", 3)
+    assert got == [1, 2, 3]  # exact order the pop loop used to grant
+    assert pool.free_blocks == 5
+    pool.release("a")
+
+    class DelBomb(list):
+        def __delitem__(self, index):
+            raise RuntimeError("mid-grant failure")
+
+    pool._free = DelBomb(pool._free)
+    with pytest.raises(RuntimeError, match="mid-grant"):
+        pool.alloc("b", 2)
+    assert "b" not in pool._held and pool.free_blocks == 8
+    pool._free = list(pool._free)
+    pool.check_drained()
+
+
+def test_admission_failure_after_grant_releases_blocks(params, monkeypatch):
+    """Regression: a failure between the block grant and the request
+    landing in its slot (table build, slot bookkeeping) must hand the
+    blocks back before propagating — check_drained() used to report a
+    leak for a request that never ran, and the slot stayed poisoned."""
+    import pyrecover_tpu.serving.engine as serving_engine
+
+    engine = ServingEngine(params, CFG, ServingConfig(
+        block_size=8, max_seqs=2, prefill_chunk=8, prefill_token_budget=8,
+        num_blocks=8,
+    ))
+    engine.submit([1] * 8, 4)
+
+    def boom(width, block_ids=None):
+        raise RuntimeError("table build failed")
+
+    monkeypatch.setattr(serving_engine, "make_block_table", boom)
+    with pytest.raises(RuntimeError, match="table build failed"):
+        engine._admit()
+    engine.pool.check_drained()  # the grant was handed back
+    assert all(s is None for s in engine._slots)
+    monkeypatch.undo()
+    # the engine stays serviceable: a fresh request admits and drains
+    rid = engine.submit([1] * 8, 4)
+    engine.run_until_drained()
+    assert engine.result(rid) == generate_tokens(params, CFG, [1] * 8, 4)
+    engine.pool.check_drained()

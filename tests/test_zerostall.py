@@ -721,3 +721,141 @@ def test_driver_mixed_engines_resume_their_own(tmp_path):
     # both engines' checkpoints coexist
     assert list_checkpoints(tmp_path / "zs", engine="vanilla")
     assert list_checkpoints(tmp_path / "zs", engine="zerostall")
+
+
+# ---------------------------------------------------------------------------
+# pin-lease error paths + the GC/prune fault seams (faultcheck FT02/FT05)
+# ---------------------------------------------------------------------------
+
+
+def test_pin_publish_failure_leaves_no_orphan_lease(tmp_path, monkeypatch):
+    """A pin writer that dies at the rename must leave NOTHING behind:
+    no half-published lease (GC would count phantom references) and no
+    staging litter (the finally sweeps its own tmp)."""
+    import errno
+    import os
+
+    from pyrecover_tpu.checkpoint.zerostall import pins
+
+    mpath = tmp_path / "ckpt_1.zs.json"
+    mpath.write_text(json.dumps({"leaves": []}))
+
+    def no_publish(src, dst):
+        raise OSError(errno.EIO, "injected publish failure")
+
+    monkeypatch.setattr(os, "replace", no_publish)
+    with pytest.raises(OSError):
+        pins.pin_manifest(tmp_path, mpath, owner="t")
+    pdir = pins.pins_dir(tmp_path)
+    assert list(pdir.glob(f"*{pins.PIN_SUFFIX}")) == []
+    assert list(pdir.glob("*.tmp")) == []
+
+
+def test_pin_write_failure_mid_copy_cleans_staging(tmp_path, monkeypatch):
+    import errno
+    import os
+
+    from pyrecover_tpu.checkpoint.zerostall import pins
+
+    mpath = tmp_path / "ckpt_1.zs.json"
+    mpath.write_text(json.dumps({"leaves": []}))
+
+    def no_fsync(fd):
+        raise OSError(errno.EIO, "injected fsync failure")
+
+    monkeypatch.setattr(os, "fsync", no_fsync)
+    with pytest.raises(OSError):
+        pins.pin_manifest(tmp_path, mpath, owner="t")
+    assert list(pins.pins_dir(tmp_path).iterdir()) == []
+
+
+def test_pin_release_idempotent_after_expiry(tmp_path):
+    import os
+
+    from pyrecover_tpu.checkpoint.zerostall import pins
+
+    mpath = tmp_path / "ckpt_1.zs.json"
+    mpath.write_text(json.dumps({"leaves": []}))
+    lease = pins.pin_manifest(tmp_path, mpath, owner="t")
+    old = time.time() - 1000
+    os.utime(lease.path, (old, old))
+    removed = pins.expire_stale_pins(tmp_path, ttl_s=10)
+    assert removed == [lease.path.name]
+    lease.release()  # collected underneath us: a no-op, not ENOENT
+    lease.release()  # and idempotent on repeat
+
+
+def test_expire_stale_pins_sweeps_tmp_orphans_by_the_same_clock(tmp_path):
+    """A pin writer killed between mkstemp and the rename leaves a .tmp
+    no release() will ever unlink; the TTL sweep collects it while a
+    fresh .tmp (a write still in flight) and a live lease survive."""
+    import os
+
+    from pyrecover_tpu.checkpoint.zerostall import pins
+
+    mpath = tmp_path / "ckpt_1.zs.json"
+    mpath.write_text(json.dumps({"leaves": []}))
+    lease = pins.pin_manifest(tmp_path, mpath, owner="t")
+    pdir = pins.pins_dir(tmp_path)
+    orphan = pdir / "ckpt_0.zs.json.dead.pin.x1.tmp"
+    orphan.write_bytes(b"{")
+    old = time.time() - 1000
+    os.utime(orphan, (old, old))
+    fresh = pdir / "ckpt_2.zs.json.live.pin.x2.tmp"
+    fresh.write_bytes(b"{")
+    removed = pins.expire_stale_pins(tmp_path, ttl_s=10)
+    assert removed == [orphan.name]
+    assert fresh.exists() and lease.path.exists()
+    lease.release()
+
+
+def test_gc_unlink_drill_interrupts_sweep_keeps_manifests_restorable(
+    tmp_ckpt_dir,
+):
+    """The ckpt_gc_unlink seam's proof load: an EIO injected between
+    victim selection and the unlink aborts the sweep mid-pass, every
+    live manifest still prechecks, and the next pass (fault drained)
+    finishes the collection."""
+    state = make_state(seed=31)
+    exp = tmp_ckpt_dir / "exp"
+    p1 = checkpoint_path(tmp_ckpt_dir, "exp", 1, engine="zerostall")
+    save_ckpt_zerostall(p1, state, extra_meta={"step": 1}, background=False)
+    store = chunkstore.ChunkStore(exp)
+    for fill in (1, 2):
+        store.put(bytes([fill]) * 3000)  # orphans from a torn save
+    faults.install({"faults": [
+        {"type": "transient_io_error", "op": "gc_unlink", "fail_count": 1},
+    ]})
+    with pytest.raises(OSError):
+        chunkstore.collect_garbage(exp)
+    ok, why = precheck_ckpt_zerostall(p1, verify=True)
+    assert ok, why
+    removed, _ = chunkstore.collect_garbage(exp)
+    assert removed == 2
+    ok, why = precheck_ckpt_zerostall(p1, verify=True)
+    assert ok, why
+
+
+def test_prune_drill_half_finished_prune_stays_restorable(tmp_ckpt_dir):
+    """The ckpt_prune seam's proof load: retention interrupted between
+    victim selection and the deletion removes NOTHING, and the rerun
+    prunes exactly the doomed set while the survivor stays loadable."""
+    from pyrecover_tpu.checkpoint.vanilla import precheck_ckpt_vanilla
+
+    state = make_state(seed=32)
+    exp = tmp_ckpt_dir / "exp"
+    for step in (1, 2, 3):
+        p = checkpoint_path(tmp_ckpt_dir, "exp", step)
+        save_ckpt_vanilla(p, state, verify=True)
+    faults.install({"faults": [
+        {"type": "transient_io_error", "op": "prune", "fail_count": 1},
+    ]})
+    with pytest.raises(OSError):
+        prune_checkpoints(exp, max_keep=1, engine="vanilla")
+    assert [parse_step(p)
+            for p in list_checkpoints(exp, engine="vanilla")] == [1, 2, 3]
+    doomed = prune_checkpoints(exp, max_keep=1, engine="vanilla")
+    assert [parse_step(p) for p in doomed] == [1, 2]
+    (survivor,) = list_checkpoints(exp, engine="vanilla")
+    ok, why = precheck_ckpt_vanilla(survivor, verify=True)
+    assert ok, why

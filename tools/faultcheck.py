@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""faultcheck CLI — static crash-consistency & fault-coverage analysis.
+
+Usage:
+    python tools/faultcheck.py pyrecover_tpu/ --strict
+    python tools/faultcheck.py --list-rules
+    python tools/faultcheck.py pyrecover_tpu/ --list-sites
+    python tools/faultcheck.py pyrecover_tpu/ --json /tmp/faultcheck.json
+
+All logic lives in ``pyrecover_tpu.analysis.faultcheck`` (durability
+model in ``model.py``, rules FT01–FT06 in ``rules.py``, suppression
+syntax shared with jaxlint/concur/distcheck/obscheck under the
+``faultcheck:`` comment namespace); this file is the executable shim so
+the analyzer is runnable before the package is installed.
+"""
+
+import sys
+from pathlib import Path
+
+# runnable from any cwd, installed or not
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from pyrecover_tpu.analysis.faultcheck.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
