@@ -457,4 +457,76 @@ else
   rc=1
 fi
 
+# serving-fleet smoke: the front door's gate (pyrecover_tpu/serving/
+# fleet). Two real drills behind tools/bench_decode.py --fleet-smoke:
+# (a) replica-loss chaos — >=2 replica subprocesses under seeded
+# open-loop load, one SIGKILLed mid-flight through the replica_kill
+# seam (rc -9, announce-then-kill trail in its telemetry shard) while
+# the router's redrive seam eats an injected transient I/O error;
+# fails unless accounting is exact (submitted == done + shed, zero
+# silent losses), >=1 request was explicitly redriven with results
+# bit-identical to the no-kill baseline, the kill-window fleet p99
+# stays inside the gate, zero-capacity admission sheds LOUDLY (3/3
+# fleet_shed), the supervisor respawns the dead replica (probe equal
+# to a cold restore) and quarantines a crash-looper after exactly 3
+# spawns. (b) canary rollback — a divergent manifest fails the canary
+# token gate, auto-rolls-back to the pin-leased old manifest on every
+# replica (probe equal to a cold restore), and a healthy manifest
+# waves with zero rejections. The merged per-replica telemetry is then
+# fed to summarize_telemetry, which must render the fleet section.
+FLEETSMOKE_WORK="${FLEETSMOKE_WORK:-/tmp/pyrecover_fleet_smoke}"
+rm -rf "$FLEETSMOKE_WORK"
+if FS_OUT=$(JAX_PLATFORMS=cpu python tools/bench_decode.py \
+    --fleet-smoke "$FLEETSMOKE_WORK" 2>&1); then
+  FS_LINE=$(echo "$FS_OUT" | grep '"metric": "fleet_smoke"' | tail -1) \
+    || FS_LINE=""
+  FS_LINE="$FS_LINE" python - <<'PYEOF' || rc=1
+import json, os
+rep = json.loads(os.environ["FS_LINE"])
+assert rep["ok"] and rep["metric"] == "fleet_smoke", rep
+ch = rep["chaos"]
+assert ch["killed_rc"] == -9, f"replica not SIGKILLed: {ch}"
+assert ch["redriven"] >= 1, f"death produced no redrive: {ch}"
+assert ch["kill_p99_s"] <= ch["p99_gate_s"], \
+    f"kill-window p99 {ch['kill_p99_s']}s broke the gate {ch['p99_gate_s']}s"
+assert ch["shed"] == 3, f"zero-capacity admission did not shed 3/3: {ch}"
+assert ch["respawns"] >= 1, f"dead replica never respawned: {ch}"
+assert ch["quarantine_spawns"] == 3, \
+    f"crash-looper not quarantined after exactly 3 spawns: {ch}"
+assert ch["aggregator_targets"] == ch["replicas"], ch
+ca = rep["canary"]
+assert ca["divergent_verdict"] == "fail" \
+    and ca["divergent_reason"] == "token_mismatch", \
+    f"divergent manifest leaked past the canary gate: {ca}"
+assert ca["healthy_verdict"] == "pass" and ca["healthy_waved"] >= 1, \
+    f"healthy rollout did not wave: {ca}"
+print(f"fleet smoke: OK — chaos: {ch['replicas']} replicas, "
+      f"{ch['requests']} requests, kill rc {ch['killed_rc']}, "
+      f"{ch['redriven']} redriven, p99 {ch['kill_p99_s']}s <= gate "
+      f"{ch['p99_gate_s']}s, {ch['shed']}/3 shed loudly, "
+      f"{ch['respawns']} respawn(s), crash-looper parked after "
+      f"{ch['quarantine_spawns']} spawns; canary: divergent "
+      f"{ca['divergent_verdict']} ({ca['divergent_reason']}) -> rolled "
+      f"back, healthy {ca['healthy_verdict']} waved "
+      f"{ca['healthy_waved']} replica(s)")
+PYEOF
+else
+  echo "$FS_OUT"
+  rc=1
+fi
+if FS_SUM=$(JAX_PLATFORMS=cpu python tools/summarize_telemetry.py \
+    "$FLEETSMOKE_WORK/chaos/fleet_telemetry.jsonl" \
+    --json "$FLEETSMOKE_WORK/fleet_summary.json" 2>&1); then
+  if echo "$FS_SUM" | grep -q "serving fleet (front door)" \
+      && echo "$FS_SUM" | grep -q "redrives"; then
+    echo "$FS_SUM" | grep -A 6 "serving fleet (front door)" | head -7
+  else
+    echo "summarize_telemetry: serving-fleet section missing"
+    rc=1
+  fi
+else
+  echo "$FS_SUM"
+  rc=1
+fi
+
 exit $rc

@@ -126,6 +126,19 @@ FAULT_SITES = {
                  "save_index=0 (a serving replica never saves); "
                  "ctx: path, written",
     },
+    "replica_kill": {
+        "module": "serving/fleet/replica.py", "kind": "kill",
+        "drill": "fleet chaos drill kill9_during_save site=replica_kill "
+                 "save_index=0, after_bytes = completed-request count "
+                 "(a replica never saves; `written` counts requests "
+                 "served); ctx: replica, written",
+    },
+    "router_redrive": {
+        "module": "serving/fleet/router.py", "kind": "redrive",
+        "drill": "fleet chaos drill transient_io_error op=redrive (a "
+                 "redrive that EIOs must retry, never drop the "
+                 "request); ctx: rid, replica",
+    },
     "loader_batch": {
         "module": "data/loader.py", "kind": "stall",
         "drill": "chaos hang drill loader_stall; ctx: batch",
@@ -211,12 +224,14 @@ class _Kill9DuringSave(_Fault):
     (``ckpt_write``, the default-compatible site), any zerostall
     pipeline stage (``ckpt_snapshot`` mid device→host copy,
     ``ckpt_chunk_write`` mid chunk store write, ``ckpt_manifest_commit``
-    between the durable chunks and the manifest rename), or the serving
+    between the durable chunks and the manifest rename), the serving
     hot-swap fetch (``swap_fetch`` — a reader process; pass
-    ``save_index: 0`` since a serving replica never saves)."""
+    ``save_index: 0`` since a serving replica never saves), or the
+    fleet replica's serve loop (``replica_kill`` — ``after_bytes``
+    counts completed requests there, and ``save_index: 0`` again)."""
 
     sites = ("ckpt_write", "ckpt_snapshot", "ckpt_chunk_write",
-             "ckpt_manifest_commit", "swap_fetch")
+             "ckpt_manifest_commit", "swap_fetch", "replica_kill")
     type_name = "kill9_during_save"
 
     def __init__(self, spec):
@@ -366,13 +381,14 @@ class _TransientIOError(_Fault):
 
     sites = ("ckpt_write", "ckpt_fsync", "ckpt_rename", "ckpt_read",
              "ckpt_chunk_write", "ckpt_manifest_commit",
-             "ckpt_gc_unlink", "ckpt_prune")
+             "ckpt_gc_unlink", "ckpt_prune", "router_redrive")
     type_name = "transient_io_error"
     _OPS = {"write": "ckpt_write", "fsync": "ckpt_fsync",
             "rename": "ckpt_rename", "read": "ckpt_read",
             "chunk_write": "ckpt_chunk_write",
             "manifest_commit": "ckpt_manifest_commit",
             "gc_unlink": "ckpt_gc_unlink", "prune": "ckpt_prune",
+            "redrive": "router_redrive",
             "any": None}
 
     def __init__(self, spec):

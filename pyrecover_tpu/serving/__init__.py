@@ -36,6 +36,7 @@ spans ``req_queue`` / ``req_prefill`` / ``req_decode`` /
 """
 
 from pyrecover_tpu.serving.engine import (
+    EngineStoppedError,
     Request,
     ServingConfig,
     ServingEngine,
@@ -51,9 +52,11 @@ from pyrecover_tpu.serving.kvpool import (
 from pyrecover_tpu.serving.loadgen import (
     lockstep_baseline,
     open_loop_workload,
+    request_id,
     run_loadgen,
     sample_workload,
     serving_smoke,
+    split_workload,
 )
 from pyrecover_tpu.serving.paged import paged_attention, paged_forward
 from pyrecover_tpu.serving.restore import (
@@ -63,6 +66,7 @@ from pyrecover_tpu.serving.restore import (
 
 __all__ = [
     "BlockPool",
+    "EngineStoppedError",
     "HotSwapper",
     "Request",
     "ServingConfig",
@@ -76,8 +80,10 @@ __all__ = [
     "open_loop_workload",
     "paged_attention",
     "paged_forward",
+    "request_id",
     "resident_sequences",
     "run_loadgen",
     "sample_workload",
     "serving_smoke",
+    "split_workload",
 ]

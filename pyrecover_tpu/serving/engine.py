@@ -71,6 +71,16 @@ from pyrecover_tpu.telemetry import metrics
 QUEUED, PREFILL, RUNNING, DONE = "queued", "prefill", "running", "done"
 
 
+class EngineStoppedError(RuntimeError):
+    """``submit()`` after ``stop()``: the engine is closed to new work.
+
+    A router redriving a dead replica's requests needs a loud, typed
+    signal that a target engine is no longer accepting submissions —
+    before this, a post-stop submit queued silently and the caller's
+    future wedged until the next (never-coming) scheduler pass.
+    ``reopen()`` re-arms submissions for manual ``step()`` pumping."""
+
+
 @dataclasses.dataclass
 class ServingConfig:
     """Engine sizing knobs (all static — one compile per chunk width)."""
@@ -184,6 +194,7 @@ class ServingEngine:
         # for the next step boundary — serving/hotswap/swap.py)
         self._lock = threading.Lock()
         self._waiting = []  # FIFO of QUEUED requests
+        self._closed = False  # set by stop(): submit() raises, loudly
         self._next_rid = 0
         self._staged_swap = None  # set by install_params, consumed by _pump
         self.weights_step = None  # step of the serving weights, if known
@@ -257,6 +268,12 @@ class ServingEngine:
             t_submit=time.monotonic(),
         )
         with self._lock:
+            if self._closed:
+                raise EngineStoppedError(
+                    "engine is stopped: submit() after stop() would queue "
+                    "a request no scheduler pass will ever run (start() "
+                    "or reopen() to accept work again)"
+                )
             req.rid = self._next_rid
             self._next_rid += 1
             self._waiting.append(req)
@@ -357,10 +374,24 @@ class ServingEngine:
         if self._loop_owner() is not None:
             raise RuntimeError("serving loop already running")
         self._stop.clear()
+        with self._lock:
+            self._closed = False
         self._thread = threading.Thread(
             target=self._serve_loop, name="serving-engine",
         )
         self._thread.start()
+
+    def reopen(self):  # jaxlint: host-only
+        """Re-arm ``submit()`` after ``stop()`` for manual ``step()``
+        pumping (the drill probes submit-then-drain against an engine
+        whose background loop already exited). Refuses while a
+        background loop owns the engine — use ``start()`` for that."""
+        if self._loop_owner() is not None:
+            raise RuntimeError(
+                "serving loop is running; reopen() is for manual pumping"
+            )
+        with self._lock:
+            self._closed = False
 
     def stop(self, timeout=60.0):  # jaxlint: host-only
         """Stop and JOIN the background loop (bounded — a wedged device
@@ -377,6 +408,11 @@ class ServingEngine:
                 "serving-engine thread did not stop within "
                 f"{timeout}s"
             )
+        # closed only once the loop actually exited: a timed-out join
+        # leaves the engine open so the wedged-thread recovery path
+        # (submit once the loop dies on its own) keeps working
+        with self._lock:
+            self._closed = True
         self._thread = None
         # final partial interval: without this the metrics accumulated
         # since the last periodic flush would never reach the stream

@@ -1,0 +1,56 @@
+"""pyrecover_tpu.serving.fleet — the serving-fleet front door.
+
+Resilience as a *fleet* property (ROADMAP item 1's "millions of users"
+posture): N serving-replica subprocesses — each a PR 12
+``ServingEngine`` + PR 15 ``HotSwapper`` — behind one front-door
+process, speaking a newline-delimited-JSON socket protocol so a replica
+death is an EOF, never a wedged collective:
+
+  * :mod:`protocol` — NDJSON-over-TCP framing: locked whole-line
+    sends, reader-thread dispatch, EOF-as-death signaling.
+  * :mod:`replica` — the replica subprocess entry: engine + swapper +
+    metrics exporter behind a fleet socket, readiness over a status
+    JSONL, and the ``replica_kill`` announce-then-kill chaos seam.
+  * :mod:`supervisor` — spawn/ready/dead/backoff state machine per
+    replica slot: capped exponential restart backoff (the ``retry.py``
+    discipline) and crash-loop quarantine after N strikes.
+  * :mod:`router` — least-loaded dispatch with optional session
+    affinity, SLO-aware admission (bounded per-replica inflight +
+    bounded queue, loud shedding), and redrive-on-death: deterministic
+    request ids + per-request ownership convert a replica death into a
+    re-queue through the ``router_redrive`` fault seam under
+    ``io_retry`` — never a silent loss.
+  * :mod:`rollout` — hot-swap as a rollout policy: canary one replica,
+    gate on probe token-equality + p99-vs-baseline, wave on pass,
+    auto-rollback to the pin-leased old manifest on fail.
+  * :mod:`drill` — the format.sh-gated proofs: the replica-loss chaos
+    drill and the canary-rollback drill.
+
+Event catalog additions (documented in ``telemetry/__init__`` and the
+README event table): ``replica_spawned``, ``replica_dead``,
+``replica_quarantined``, ``request_redriven``, ``fleet_shed``,
+``canary_verdict``. Fault sites: ``replica_kill``, ``router_redrive``.
+"""
+
+from pyrecover_tpu.serving.fleet.protocol import Connection, ProtocolError
+from pyrecover_tpu.serving.fleet.rollout import canary_rollout
+from pyrecover_tpu.serving.fleet.router import FleetRouter
+from pyrecover_tpu.serving.fleet.supervisor import (
+    BACKOFF,
+    QUARANTINED,
+    READY,
+    SPAWNING,
+    ReplicaSupervisor,
+)
+
+__all__ = [
+    "BACKOFF",
+    "Connection",
+    "FleetRouter",
+    "ProtocolError",
+    "QUARANTINED",
+    "READY",
+    "ReplicaSupervisor",
+    "SPAWNING",
+    "canary_rollout",
+]

@@ -1,0 +1,255 @@
+"""Serving-replica subprocess: one ``ServingEngine`` + ``HotSwapper``
+behind a fleet socket.
+
+``python -m pyrecover_tpu.serving.fleet.replica --exp DIR --status FILE``
+loads the latest (or ``--manifest``-pinned) checkpoint, warms the
+compile caches, starts the engine's background loop, opens a TCP
+listener on an ephemeral port, and reports readiness to the status
+JSONL the supervisor tails::
+
+    {"event": "ready", "replica", "port", "metrics_port", "pid", "step"}
+
+The replica then serves the fleet protocol (see :mod:`protocol`):
+``submit`` feeds the engine and a completer thread pushes ``done``
+messages back as results finish; ``probe`` runs the seeded probe
+workload through the live engine and reports tokens + per-request e2e
+latency; ``swap`` drives the hot-swapper's ``swap_to`` (the rollout
+controller owns *when* — the watcher thread is deliberately not
+started); ``status`` snapshots queue depth and the loaded step;
+``shutdown`` exits cleanly.
+
+Chaos seam: after EVERY request completes — but before its ``done`` is
+reported — the replica fires ``faults.check("replica_kill",
+replica=..., written=<completed count>)``. The ``kill9_during_save``
+fault type announces ``fault_injected`` to the replica's telemetry
+shard and then SIGKILLs the process (announce-then-kill), so a kill
+deterministically orphans the triggering request: the fleet chaos
+drill murders a replica mid-flight with an auditable trail and a
+guaranteed redrive. Exit codes:
+0 clean, 2 no checkpoint to serve (the crash-loop drill's fast-failure
+mode).
+"""
+
+import argparse
+import os
+import socket
+import sys
+import threading
+import time
+from pathlib import Path
+
+from pyrecover_tpu import telemetry
+from pyrecover_tpu.resilience import faults
+from pyrecover_tpu.serving.fleet.protocol import Connection
+
+_PROBE_TIMEOUT_S = 120.0
+
+
+class _ReplicaState:
+    """Cross-thread state shared by the connection handler (reader
+    thread) and the completer thread. Everything mutable lives behind
+    ``lock``; ``stop`` is the process-wide shutdown latch."""
+
+    def __init__(self, replica_id):
+        self.replica_id = replica_id
+        self.lock = threading.Lock()
+        self.outstanding = {}  # engine rid -> fleet rid
+        self.completed = 0
+        self.stop = threading.Event()
+
+
+def _probe_with_latency(engine, probe):  # jaxlint: host-only
+    """Serve the probe through the live engine, returning token lists in
+    submission order plus per-request e2e seconds (submit → done)."""
+    t0 = {}
+    rids = []
+    for req in probe:
+        rid = engine.submit(req["prompt"], req["max_new_tokens"])
+        t0[rid] = time.monotonic()
+        rids.append(rid)
+    e2e = {}
+    deadline = time.monotonic() + _PROBE_TIMEOUT_S
+    while len(e2e) < len(rids):
+        for rid in rids:
+            if rid not in e2e and engine.result(rid) is not None:
+                e2e[rid] = time.monotonic() - t0[rid]
+        if time.monotonic() > deadline:
+            raise TimeoutError("fleet replica: probe did not drain")
+        time.sleep(0.002)
+    return [engine.result(r) for r in rids], [e2e[r] for r in rids]
+
+
+def _handle(msg, conn, *, state, engine, swapper, probe_seed):  # jaxlint: host-only
+    """Dispatch one inbound fleet message (runs on the reader thread)."""
+    from pyrecover_tpu.serving.hotswap.drill import _probe_workload
+
+    kind = msg.get("type")
+    if kind == "submit":
+        erid = engine.submit(msg["prompt"], msg["max_new_tokens"])
+        with state.lock:
+            state.outstanding[erid] = msg["rid"]
+    elif kind == "probe":
+        probe = _probe_workload(int(msg.get("seed", probe_seed)))
+        tokens, e2e = _probe_with_latency(engine, probe)
+        conn.send({"type": "probe_result", "tokens": tokens, "e2e_s": e2e})
+    elif kind == "swap":
+        path = Path(msg["manifest"])
+        ok = swapper.swap_to(path)
+        reason = "" if ok else swapper.rejected.get(path.name, "unknown")
+        conn.send({
+            "type": "swap_result", "ok": bool(ok),
+            "step": swapper.loaded_step, "reason": reason,
+        })
+    elif kind == "status":
+        with state.lock:
+            completed = state.completed
+        conn.send({
+            "type": "status_result", "pending": engine.pending,
+            "completed": completed, "loaded_step": swapper.loaded_step,
+            "rejected": len(swapper.rejected),
+        })
+    elif kind == "shutdown":
+        state.stop.set()
+
+
+def _completer(state, engine, conn, conn_done):  # jaxlint: host-only
+    """Poll finished engine results and push ``done`` frames back to the
+    router. The ``replica_kill`` seam fires after a result is computed
+    but BEFORE it is reported, so a kill always leaves work the dead
+    replica still owns: everything reported is done, the triggering
+    request (and anything behind it) is the router's to redrive —
+    the exact zero-silent-loss boundary the chaos drill asserts."""
+    while not conn_done.is_set() and not state.stop.is_set():
+        with state.lock:
+            items = list(state.outstanding.items())
+        for erid, rid in items:
+            tokens = engine.result(erid)
+            if tokens is None:
+                continue
+            with state.lock:
+                state.completed += 1
+                completed = state.completed
+            faults.check(
+                "replica_kill", replica=state.replica_id, written=completed,
+            )
+            try:
+                conn.send({"type": "done", "rid": rid, "tokens": tokens})
+            except OSError:
+                return  # router gone; the connection loop winds down
+            with state.lock:
+                state.outstanding.pop(erid, None)
+        time.sleep(0.002)
+
+
+def serve(args):  # jaxlint: host-only
+    from pyrecover_tpu.checkpoint.registry import (
+        get_latest_checkpoint,
+        parse_step,
+    )
+
+    exp = Path(args.exp)
+    telem_path = (
+        Path(args.telemetry) if args.telemetry
+        else exp / f"replica_{args.replica_id}_telemetry.jsonl"
+    )
+    sink = telemetry.JsonlSink(telem_path)
+    telemetry.add_sink(sink)
+    path = Path(args.manifest) if args.manifest else get_latest_checkpoint(exp)
+    if path is None:
+        # fast failure BEFORE the heavy engine imports: this is the
+        # crash-loop drill's repeatable rc-2 mode
+        print(f"fleet replica: no checkpoint in {exp}", file=sys.stderr)
+        return 2
+
+    from pyrecover_tpu.serving.engine import ServingEngine
+    from pyrecover_tpu.serving.hotswap.drill import (
+        _append_status,
+        _drill_model_config,
+        _serving_config,
+    )
+    from pyrecover_tpu.serving.hotswap.swap import HotSwapper
+    from pyrecover_tpu.serving.restore import load_serving_params
+    from pyrecover_tpu.telemetry.exporter import MetricsExporter
+
+    cfg = _drill_model_config()
+    params, _ = load_serving_params(path, cfg)
+    engine = ServingEngine(params, cfg, _serving_config())
+    # warm both compiled programs outside any measured window
+    engine.submit([1, 2, 3], 2)
+    engine.run_until_drained()
+    engine.start()
+    # the rollout controller drives swaps over the wire; no watcher
+    swapper = HotSwapper(engine, exp, cfg, loaded_path=path)
+    exporter = MetricsExporter(port=0)
+    exporter.start()
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(4)
+    lsock.settimeout(0.2)
+    state = _ReplicaState(args.replica_id)
+    _append_status(args.status, {
+        "event": "ready", "replica": args.replica_id,
+        "port": lsock.getsockname()[1], "metrics_port": exporter.port,
+        "pid": os.getpid(), "step": parse_step(path),
+    })
+    deadline = time.monotonic() + args.serve_s
+    try:
+        while not state.stop.is_set() and time.monotonic() < deadline:
+            try:
+                csock, _ = lsock.accept()
+            except socket.timeout:
+                continue
+            conn_done = threading.Event()
+
+            def handler(msg, conn):
+                _handle(msg, conn, state=state, engine=engine,
+                        swapper=swapper, probe_seed=args.probe_seed)
+
+            conn = Connection(
+                csock, handler, name=f"replica{args.replica_id}",
+                on_eof=lambda _c: conn_done.set(),
+            )
+            pump = threading.Thread(
+                target=_completer, args=(state, engine, conn, conn_done),
+                name=f"fleet-completer-{args.replica_id}", daemon=True,
+            )
+            pump.start()
+            while not conn_done.is_set() and not state.stop.is_set():
+                if time.monotonic() > deadline:
+                    break
+                conn_done.wait(0.2)
+            conn_done.set()
+            pump.join(10.0)
+            if pump.is_alive():
+                raise TimeoutError("fleet replica: completer did not exit")
+            conn.close()
+    finally:
+        lsock.close()
+        engine.stop()
+        exporter.stop()
+        telemetry.remove_sink(sink)
+        sink.close()
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--exp", required=True,
+                    help="experiment dir to serve checkpoints from")
+    ap.add_argument("--status", required=True,
+                    help="status JSONL the supervisor tails for readiness")
+    ap.add_argument("--manifest", default=None,
+                    help="serve this checkpoint (default: registry latest)")
+    ap.add_argument("--replica-id", type=int, default=0)
+    ap.add_argument("--probe-seed", type=int, default=0)
+    ap.add_argument("--telemetry", default=None,
+                    help="per-replica telemetry shard (JSONL)")
+    ap.add_argument("--serve-s", type=float, default=600.0,
+                    help="serving window before a clean exit")
+    args = ap.parse_args(argv)
+    return serve(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,325 @@
+"""Fleet front-door router: least-loaded dispatch, SLO-aware admission,
+and redrive-on-death.
+
+The :class:`FleetRouter` owns every accepted request until it is done
+or explicitly shed — never silently dropped:
+
+* **Dispatch** is least-loaded (fewest outstanding requests) over the
+  currently-attached replicas, with optional deterministic session
+  affinity (``req["session"]`` hashes to a preferred replica; falls
+  back to least-loaded when that replica is full or gone).
+* **Admission** is SLO-aware: each replica carries at most
+  ``max_inflight`` outstanding requests (the fleet-level face of the
+  per-replica ``kv_backpressure`` signal — a replica that is stalling
+  on KV blocks stops absorbing new work instead of queueing it into an
+  OOM), overflow waits in a bounded router queue, and when THAT is full
+  the request is **shed loudly**: a ``fleet_shed`` event and an exact
+  entry in the accounting (``submitted == done + shed`` at drain).
+* **Redrive**: request ids are deterministic (loadgen's
+  ``request_id(seed, index)``) and the router tracks per-request
+  ownership, so a replica death (connection EOF) converts every
+  orphaned request into a ``request_redriven`` event plus a re-queue at
+  the FRONT of the queue. The re-queue runs under ``io_retry`` wrapping
+  the ``router_redrive`` fault seam — an injected transient I/O error
+  retries with backoff, it never drops the request. Duplicate ``done``
+  frames (a replica that finished just as we redrove) dedup by rid.
+
+Single structural lock (``_lock``) guards all tables; socket work
+(connect, send) happens outside it (CC02). Reader threads live in
+:class:`protocol.Connection`; ``close()`` bounds every join (CC05).
+"""
+
+import threading
+import time
+from collections import deque
+
+from pyrecover_tpu import telemetry
+from pyrecover_tpu.resilience import faults
+from pyrecover_tpu.resilience.retry import io_retry
+from pyrecover_tpu.serving.fleet import protocol
+
+_REPLY_TYPES = ("probe_result", "swap_result", "status_result")
+
+
+class FleetRouter:
+    """Route requests across replica connections; see module docstring."""
+
+    def __init__(self, *, max_inflight=8, max_queue=256, affinity=False):
+        self.max_inflight = int(max_inflight)
+        self.max_queue = int(max_queue)
+        self.affinity = bool(affinity)
+        self._lock = threading.Lock()
+        # every table below is guarded by _lock
+        self._links = {}        # replica_id -> Connection
+        self._outstanding = {}  # replica_id -> set of rids
+        self._requests = {}     # rid -> request dict (accepted + shed)
+        self._owner = {}        # rid -> replica_id | None (queued)
+        self._queue = deque()   # rids waiting for capacity
+        self._results = {}      # rid -> token list
+        self._shed = set()      # rids refused at admission
+        self._redrives = {}     # rid -> redrive attempts
+        self._t_submit = {}     # rid -> monotonic submit time
+        self._t_done = {}       # rid -> monotonic done time
+        self._waiters = {}      # replica_id -> {reply_type: (Event, box)}
+
+    # ---- replica attachment ----------------------------------------------
+
+    def connect(self, replica_id, host, port, *, timeout_s=10.0):  # jaxlint: host-only
+        """Dial a replica and attach it as a dispatch target; queued
+        requests start flowing to it immediately."""
+        sock = protocol.connect(host, port, timeout_s=timeout_s)
+        conn = protocol.Connection(
+            sock,
+            lambda msg, _c: self._on_message(replica_id, msg),
+            name=f"router-r{replica_id}",
+            on_eof=lambda _c: self._on_disconnect(replica_id),
+        )
+        with self._lock:
+            self._links[replica_id] = conn
+            self._outstanding.setdefault(replica_id, set())
+        self._pump()
+        return conn
+
+    def replicas(self):
+        with self._lock:
+            return sorted(self._links)
+
+    # ---- request path -----------------------------------------------------
+
+    def submit(self, req):  # jaxlint: host-only
+        """Admit one request dict (``rid``/``prompt``/``max_new_tokens``,
+        optional ``session``). Returns ``"dispatched"``, ``"queued"``,
+        ``"shed"``, or ``"dup"`` (deterministic rid already known)."""
+        rid = req["rid"]
+        sends = []
+        shed_ctx = None
+        with self._lock:
+            if rid in self._requests:
+                return "dup"
+            self._requests[rid] = req
+            self._t_submit[rid] = time.monotonic()
+            target = self._pick_target_locked(req)
+            if target is not None:
+                self._dispatch_locked(rid, target, sends)
+                verdict = "dispatched"
+            elif len(self._queue) < self.max_queue:
+                self._queue.append(rid)
+                self._owner[rid] = None
+                verdict = "queued"
+            else:
+                self._shed.add(rid)
+                shed_ctx = {
+                    "queued": len(self._queue),
+                    "inflight": sum(
+                        len(s) for s in self._outstanding.values()),
+                    "replicas": len(self._links),
+                }
+                verdict = "shed"
+        if shed_ctx is not None:
+            telemetry.emit("fleet_shed", rid=rid, **shed_ctx)
+        self._send_all(sends)
+        return verdict
+
+    def _pick_target_locked(self, req):
+        """Least-loaded live replica with spare admission capacity;
+        session affinity picks a deterministic preferred replica first."""
+        candidates = [
+            r for r in sorted(self._links)
+            if len(self._outstanding.get(r, ())) < self.max_inflight
+        ]
+        if not candidates:
+            return None
+        session = req.get("session")
+        if self.affinity and session is not None:
+            ordered = sorted(self._links)
+            pref = ordered[hash(str(session)) % len(ordered)]
+            if pref in candidates:
+                return pref
+        return min(
+            candidates, key=lambda r: (len(self._outstanding[r]), r))
+
+    def _dispatch_locked(self, rid, target, sends):
+        req = self._requests[rid]
+        self._owner[rid] = target
+        self._outstanding[target].add(rid)
+        sends.append((target, {
+            "type": "submit", "rid": rid, "prompt": req["prompt"],
+            "max_new_tokens": req["max_new_tokens"],
+        }))
+
+    def _pump_locked(self, sends):
+        while self._queue:
+            rid = self._queue[0]
+            target = self._pick_target_locked(self._requests[rid])
+            if target is None:
+                return
+            self._queue.popleft()
+            self._dispatch_locked(rid, target, sends)
+
+    def _pump(self):  # jaxlint: host-only
+        sends = []
+        with self._lock:
+            self._pump_locked(sends)
+        self._send_all(sends)
+
+    def _send_all(self, sends):  # jaxlint: host-only
+        for target, msg in sends:
+            with self._lock:
+                conn = self._links.get(target)
+            if conn is None:
+                self._on_disconnect(target)
+                continue
+            try:
+                conn.send(msg)
+            except OSError:
+                self._on_disconnect(target)
+
+    # ---- inbound ----------------------------------------------------------
+
+    def _on_message(self, replica_id, msg):  # jaxlint: host-only
+        kind = msg.get("type")
+        if kind == "done":
+            self._on_done(replica_id, msg)
+        elif kind in _REPLY_TYPES:
+            with self._lock:
+                waiter = self._waiters.get(replica_id, {}).pop(kind, None)
+            if waiter is not None:
+                event, box = waiter
+                box["reply"] = msg
+                event.set()
+
+    def _on_done(self, replica_id, msg):  # jaxlint: host-only
+        rid = msg.get("rid")
+        sends = []
+        with self._lock:
+            self._outstanding.get(replica_id, set()).discard(rid)
+            if rid in self._results or rid not in self._requests:
+                return  # duplicate done after a redrive raced completion
+            self._results[rid] = msg.get("tokens")
+            self._t_done[rid] = time.monotonic()
+            self._owner.pop(rid, None)
+            self._pump_locked(sends)
+        self._send_all(sends)
+
+    def _on_disconnect(self, replica_id):  # jaxlint: host-only
+        """Replica death: detach the link and redrive every orphaned
+        request. Idempotent — EOF and a failed send may both land here."""
+        with self._lock:
+            conn = self._links.pop(replica_id, None)
+            orphans = sorted(self._outstanding.pop(replica_id, set()))
+            waiters = self._waiters.pop(replica_id, {})
+        for event, box in waiters.values():
+            box["reply"] = None
+            event.set()
+        if conn is not None:
+            conn.close()
+        for rid in orphans:
+            self._redrive(rid, replica_id)
+
+    def _redrive(self, rid, from_replica):  # jaxlint: host-only
+        with self._lock:
+            attempt = self._redrives.get(rid, 0) + 1
+            self._redrives[rid] = attempt
+        telemetry.emit(
+            "request_redriven", rid=rid, from_replica=from_replica,
+            attempt=attempt,
+        )
+        # the redrive seam: an injected transient error retries with
+        # capped backoff — a redriven request is never dropped
+        io_retry(
+            lambda: faults.check(
+                "router_redrive", rid=rid, replica=from_replica),
+            op="redrive", path=str(rid),
+        )
+        sends = []
+        with self._lock:
+            self._owner[rid] = None
+            self._queue.appendleft(rid)
+            self._pump_locked(sends)
+        self._send_all(sends)
+
+    # ---- sync RPC (probe / swap / status) ---------------------------------
+
+    def request(self, replica_id, msg, reply_type, *, timeout_s=120.0):  # jaxlint: host-only
+        """Send one control message and wait for its typed reply. One
+        outstanding RPC per (replica, reply type). Raises on timeout or
+        replica death mid-RPC."""
+        if reply_type not in _REPLY_TYPES:
+            raise ValueError(f"unknown reply type {reply_type!r}")
+        event = threading.Event()
+        box = {}
+        with self._lock:
+            conn = self._links.get(replica_id)
+            if conn is None:
+                raise ConnectionError(
+                    f"fleet router: replica {replica_id} is not attached")
+            self._waiters.setdefault(replica_id, {})[reply_type] = (
+                event, box)
+        conn.send(msg)
+        if not event.wait(timeout_s):
+            with self._lock:
+                self._waiters.get(replica_id, {}).pop(reply_type, None)
+            raise TimeoutError(
+                f"fleet router: no {reply_type} from replica "
+                f"{replica_id} within {timeout_s}s"
+            )
+        if box.get("reply") is None:
+            raise ConnectionError(
+                f"fleet router: replica {replica_id} died mid-RPC")
+        return box["reply"]
+
+    # ---- accounting / drain ----------------------------------------------
+
+    def accounting(self):
+        with self._lock:
+            return {
+                "submitted": len(self._requests),
+                "done": len(self._results),
+                "shed": len(self._shed),
+                "queued": len(self._queue),
+                "inflight": sum(
+                    len(s) for s in self._outstanding.values()),
+                "redriven": sum(self._redrives.values()),
+                "redriven_rids": len(self._redrives),
+            }
+
+    @property
+    def results(self):
+        with self._lock:
+            return dict(self._results)
+
+    def latencies(self):
+        """Per-finished-request e2e seconds (router submit → done),
+        including any redrive detours."""
+        with self._lock:
+            return [
+                self._t_done[rid] - self._t_submit[rid]
+                for rid in self._results
+            ]
+
+    def drain(self, timeout_s=120.0):  # jaxlint: host-only
+        """Block until every accepted (non-shed) request has a result."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._lock:
+                missing = (
+                    set(self._requests) - self._shed - set(self._results))
+            if not missing:
+                return
+            if time.monotonic() > deadline:
+                acc = self.accounting()
+                raise TimeoutError(
+                    f"fleet router: {len(missing)} requests undrained "
+                    f"after {timeout_s}s ({acc})"
+                )
+            self._pump()
+            time.sleep(0.005)
+
+    def close(self, timeout=10.0):  # jaxlint: host-only
+        """Detach and close every link (bounded reader joins). Detached
+        links no longer trigger redrive — call after drain."""
+        with self._lock:
+            links = list(self._links.values())
+            self._links.clear()
+        for conn in links:
+            conn.close(timeout)

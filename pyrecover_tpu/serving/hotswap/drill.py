@@ -132,6 +132,8 @@ def _run_probe(engine, probe):
     """Serve the probe through the engine (works with the background
     loop running or via the manual pump) and return the token lists in
     submission order."""
+    if engine._loop_owner() is None:
+        engine.reopen()  # a stopped engine refuses submit() (typed)
     rids = [
         engine.submit(req["prompt"], req["max_new_tokens"]) for req in probe
     ]

@@ -16,12 +16,46 @@ equality vs lockstep, zero leaked KV blocks at drain, and a non-empty
 latency report.
 """
 
+import hashlib
 import time
 
 import numpy as np
 
 from pyrecover_tpu.serving.engine import ServingConfig, ServingEngine
 from pyrecover_tpu.telemetry import metrics
+
+
+def request_id(seed, index):
+    """Deterministic per-request id from ``(seed, index)`` — stable
+    across processes and runs (content-derived, never
+    ``PYTHONHASHSEED``-dependent), so the fleet router's redrive dedup
+    and cross-replica accounting can match a request by identity alone:
+    a redriven request carries the same id on its second replica, and
+    ``submitted == done + shed`` is checkable exactly."""
+    h = hashlib.blake2b(
+        f"{int(seed)}/{int(index)}".encode(), digest_size=6
+    ).hexdigest()
+    return f"req-{int(seed)}-{int(index):04d}-{h}"
+
+
+def split_workload(workload, targets, *, seed=0):
+    """Split one arrival stream across ``targets`` replica streams while
+    PRESERVING the global Poisson process: every request keeps its
+    global ``arrival_s`` (and ``rid``), and the target assignment is an
+    independent seeded uniform draw per request — the probabilistic
+    thinning of a Poisson process, so each per-target stream is itself
+    Poisson at ``rate/targets`` and their union is exactly the input.
+    Deterministic in ``seed``; regression-tested as an exact
+    partition."""
+    targets = int(targets)
+    if targets < 1:
+        raise ValueError(f"targets must be >= 1, got {targets}")
+    rng = np.random.default_rng([int(seed), 0x5371])  # own stream: the
+    # workload's rng sequence (prompts/lengths/arrivals) stays untouched
+    streams = [[] for _ in range(targets)]
+    for req in workload:
+        streams[int(rng.integers(0, targets))].append(req)
+    return streams
 
 
 def sample_workload(n_requests, *, vocab_size, max_model_len, seed=0,
@@ -33,7 +67,7 @@ def sample_workload(n_requests, *, vocab_size, max_model_len, seed=0,
     rng = np.random.default_rng(seed)
     reqs = []
     t = 0.0
-    for _ in range(int(n_requests)):
+    for i in range(int(n_requests)):
         p_len = int(rng.integers(prompt_lens[0], prompt_lens[1] + 1))
         n_new = int(rng.integers(new_tokens[0], new_tokens[1] + 1))
         total = p_len + n_new
@@ -41,6 +75,7 @@ def sample_workload(n_requests, *, vocab_size, max_model_len, seed=0,
             p_len = max_model_len - n_new
         t += float(rng.exponential(1.0 / arrival_rate))
         reqs.append({
+            "rid": request_id(seed, i),  # content-derived, no rng draw
             "prompt": rng.integers(0, vocab_size, (p_len,)).tolist(),
             "max_new_tokens": n_new,
             "arrival_s": t,
@@ -50,7 +85,7 @@ def sample_workload(n_requests, *, vocab_size, max_model_len, seed=0,
 
 def open_loop_workload(duration_s, *, vocab_size, max_model_len, seed=0,
                        prompt_lens=(4, 48), new_tokens=(1, 24),
-                       arrival_rate=50.0):
+                       arrival_rate=50.0, targets=1):
     """Fixed-duration open-loop mix: Poisson arrivals at
     ``arrival_rate`` req/s for ``duration_s`` seconds — the request
     COUNT is whatever the seeded arrival process produces, which is what
@@ -58,19 +93,28 @@ def open_loop_workload(duration_s, *, vocab_size, max_model_len, seed=0,
     fixed request count would let a slow server shrink its own offered
     load). Deterministic in ``seed``: the hot-swap drills run the same
     workload against the swapping and the no-swap engine and compare
-    p99 over the identical window."""
+    p99 over the identical window.
+
+    ``targets > 1`` returns the same stream split into that many
+    per-replica streams via :func:`split_workload` (Poisson thinning —
+    global arrivals and request ids preserved exactly; the fleet drill's
+    multi-target open-loop mode)."""
+    targets = int(targets)
     rng = np.random.default_rng(seed)
     reqs = []
     t = 0.0
     while True:
         t += float(rng.exponential(1.0 / arrival_rate))
         if t >= duration_s:
+            if targets > 1:
+                return split_workload(reqs, targets, seed=seed)
             return reqs
         p_len = int(rng.integers(prompt_lens[0], prompt_lens[1] + 1))
         n_new = int(rng.integers(new_tokens[0], new_tokens[1] + 1))
         if p_len + n_new > max_model_len:
             p_len = max_model_len - n_new
         reqs.append({
+            "rid": request_id(seed, len(reqs)),  # no rng draw
             "prompt": rng.integers(0, vocab_size, (p_len,)).tolist(),
             "max_new_tokens": n_new,
             "arrival_s": t,

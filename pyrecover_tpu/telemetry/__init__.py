@@ -135,6 +135,34 @@ Core event names across the stack (fields beyond the envelope):
                       zerostall fetch moves only changed-digest chunks;
                       vanilla/sharded fall back to a full read with
                       reused_bytes 0)
+    replica_spawned   replica, incarnation, pid, backoff_s (the fleet
+                      supervisor (re)spawned a serving-replica
+                      subprocess; incarnation 0 is the initial spawn,
+                      backoff_s the capped-exponential delay served
+                      before a respawn)
+    replica_dead      replica, rc, incarnation, was_ready (the
+                      supervisor observed a replica process exit; the
+                      router redrives its orphaned requests and the
+                      slot heads to backoff or quarantine)
+    replica_quarantined  replica, strikes, rc (a slot died before
+                      becoming ready `quarantine_after` consecutive
+                      times — it is parked, never respawned, so a
+                      crash-looper burns bounded capacity)
+    request_redriven  rid, from_replica, attempt (a replica died owning
+                      this accepted request; the router re-queued it at
+                      the head of the line through the router_redrive
+                      seam under io_retry — redriven, never lost)
+    fleet_shed        rid, queued, inflight, replicas (SLO-aware
+                      admission refused a request: every replica at
+                      max_inflight AND the router queue full — the
+                      shed is loud and counted, submitted == done +
+                      shed stays exact)
+    canary_verdict    verdict, manifest, reason, canary, waved,
+                      probe_p99_s, p99_gate_s (one canary rollout's
+                      outcome: "pass" waved the manifest fleet-wide,
+                      "fail" rolled every touched replica back to the
+                      pin-leased old manifest — reason is
+                      swap_rejected/token_mismatch/p99_regression)
     ckpt_policy       step, source, engine, interval_steps,
                       prev_interval_steps, optimum_steps, optimum_s,
                       cost_s, mtti_s, step_iter_s, failures_observed,

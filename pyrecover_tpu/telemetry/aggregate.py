@@ -161,6 +161,10 @@ class _Target:
         self.error = None
 
     def fail(self, error):  # jaxlint: host-only
+        # concur: disable-next=unguarded-shared-state -- single-consumer
+        # protocol: one caller drives FleetAggregator.poll() (class
+        # docstring); the flagged cross-root alias is Popen.poll() on the
+        # fleet supervisor's monitor thread, which never touches targets
         self.error = f"{type(error).__name__}: {error}"
 
     def counters(self):  # jaxlint: host-only
@@ -208,6 +212,9 @@ class FleetAggregator:
                 tgt.fail(e)
                 continue
             tgt.feed(snap, now if now is not None else time.time())
+        # concur: disable-next=unguarded-shared-state -- single-consumer
+        # protocol (class docstring); the cross-root alias is Popen.poll()
+        # on the fleet supervisor's monitor thread, not this method
         self._polls += 1
         fleet = self.snapshot(now=now)
         bus.emit(

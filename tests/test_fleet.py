@@ -1,0 +1,344 @@
+"""Serving-fleet front door: supervisor state machine over FAKE replica
+processes (the heavy subprocess drills live behind
+``tools/bench_decode.py --fleet-smoke``), router admission without any
+replica attached, the loadgen multi-target split regression, the
+submit-after-stop typed error, and the fleet event-catalog pin."""
+
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from pyrecover_tpu import telemetry
+from pyrecover_tpu.serving.fleet.supervisor import (
+    BACKOFF,
+    QUARANTINED,
+    READY,
+    SPAWNING,
+    ReplicaSupervisor,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ---- fake replica processes -------------------------------------------------
+
+
+class _FakeProc:
+    """Popen-shaped stand-in the supervisor's injected mechanics drive."""
+
+    def __init__(self, pid):
+        self.pid = pid
+        self.returncode = None
+
+    def poll(self):
+        return self.returncode
+
+    def die(self, rc):
+        self.returncode = rc
+
+    def terminate(self):
+        if self.returncode is None:
+            self.returncode = -15
+
+    def kill(self):
+        if self.returncode is None:
+            self.returncode = -9
+
+
+class _Harness:
+    """Injected spawn/ready_check over fake processes; incarnations in
+    ``self.ready`` pass the readiness probe, ``die_at_spawn`` ones are
+    born dead (the crash-loop shape)."""
+
+    def __init__(self, *, die_at_spawn=False, rc=2):
+        self.lock = threading.Lock()
+        self.procs = {}  # (slot, incarnation) -> _FakeProc
+        self.ready = set()
+        self.die_at_spawn = die_at_spawn
+        self.rc = rc
+
+    def spawn(self, slot, incarnation):
+        proc = _FakeProc(pid=1000 * (slot + 1) + incarnation)
+        if self.die_at_spawn:
+            proc.die(self.rc)
+        with self.lock:
+            self.procs[(slot, incarnation)] = proc
+        return proc
+
+    def ready_check(self, slot, incarnation, proc):
+        with self.lock:
+            if (slot, incarnation) in self.ready:
+                return {"slot": slot, "incarnation": incarnation, "port": 1}
+        return None
+
+    def mark_ready(self, slot, incarnation):
+        with self.lock:
+            self.ready.add((slot, incarnation))
+
+    def proc(self, slot, incarnation):
+        with self.lock:
+            return self.procs[(slot, incarnation)]
+
+
+def _wait(pred, timeout_s=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.005)
+    raise TimeoutError(f"supervisor test: {msg} not reached in {timeout_s}s")
+
+
+@pytest.fixture()
+def mem_sink():
+    mem = telemetry.MemorySink()
+    telemetry.add_sink(mem)
+    yield mem
+    telemetry.remove_sink(mem)
+
+
+def _events(mem, name):
+    return [e for e in mem.events if e["event"] == name]
+
+
+# ---- supervisor state machine -----------------------------------------------
+
+
+def test_supervisor_spawn_ready_death_respawn(mem_sink):
+    """The full happy-path loop: SPAWNING -> READY -> death -> BACKOFF ->
+    respawn -> READY, with the ready/death callbacks and both catalog
+    events observed."""
+    h = _Harness()
+    readies, deaths = [], []
+    sup = ReplicaSupervisor(
+        1, h.spawn, h.ready_check,
+        on_ready=lambda s, info: readies.append((s, info["incarnation"])),
+        on_death=lambda s, rc, was_ready: deaths.append((s, rc, was_ready)),
+        backoff_base_s=0.01, backoff_max_s=0.05, poll_interval_s=0.005,
+    )
+    sup.start()
+    try:
+        assert sup.state(0) in (SPAWNING, READY)
+        h.mark_ready(0, 0)
+        _wait(lambda: sup.state(0) == READY, msg="first READY")
+        assert readies == [(0, 0)]
+        assert sup.info(0)["incarnation"] == 0
+
+        h.proc(0, 0).die(-9)
+        h.mark_ready(0, 1)  # let the respawn come up
+        _wait(lambda: sup.state(0) == READY and sup.spawns(0) == 2,
+              msg="respawned READY")
+        assert deaths == [(0, -9, True)]
+        assert sup.last_rc(0) is None  # cleared by the respawn
+        assert readies == [(0, 0), (0, 1)]
+    finally:
+        sup.stop()
+    dead = _events(mem_sink, "replica_dead")
+    assert [(e["replica"], e["rc"], e["was_ready"]) for e in dead] == [
+        (0, -9, True)
+    ]
+    spawned = _events(mem_sink, "replica_spawned")
+    assert [e["incarnation"] for e in spawned if e["replica"] == 0] == [0, 1]
+    # the respawn's proc was terminated by stop()
+    assert h.proc(0, 1).returncode is not None
+
+
+def test_supervisor_backoff_is_capped_exponential(mem_sink):
+    """Each respawn's announced backoff walks min(base * 2^k, max) — the
+    retry.py discipline, visible in the replica_spawned trail."""
+    h = _Harness(die_at_spawn=True, rc=1)
+    sup = ReplicaSupervisor(
+        1, h.spawn, h.ready_check, backoff_base_s=0.01, backoff_max_s=0.04,
+        quarantine_after=10, poll_interval_s=0.002,
+    )
+    sup.start()
+    try:
+        _wait(lambda: sup.spawns(0) >= 5, msg="5 spawns")
+    finally:
+        sup.stop()
+    backoffs = [
+        e["backoff_s"] for e in _events(mem_sink, "replica_spawned")
+    ][:5]
+    assert backoffs == [0.0, 0.01, 0.02, 0.04, 0.04]
+
+
+def test_supervisor_quarantines_crash_looper(mem_sink):
+    """Deaths before READY are strikes; after exactly quarantine_after
+    spawns the slot parks in QUARANTINED and is never respawned."""
+    h = _Harness(die_at_spawn=True, rc=2)
+    sup = ReplicaSupervisor(
+        1, h.spawn, h.ready_check, backoff_base_s=0.005,
+        backoff_max_s=0.02, quarantine_after=3, poll_interval_s=0.002,
+    )
+    sup.start()
+    try:
+        _wait(lambda: sup.state(0) == QUARANTINED, msg="quarantine")
+        assert sup.spawns(0) == 3
+        assert sup.last_rc(0) == 2
+        time.sleep(0.1)  # a parked slot stays parked
+        assert sup.spawns(0) == 3
+        assert sup.state(0) == QUARANTINED
+    finally:
+        sup.stop()
+    q = _events(mem_sink, "replica_quarantined")
+    assert len(q) == 1 and q[0]["strikes"] == 3 and q[0]["rc"] == 2
+    assert len(_events(mem_sink, "replica_dead")) == 3
+
+
+def test_supervisor_ready_resets_strikes(mem_sink):
+    """Two pre-ready strikes, then READY (strikes reset), then a
+    post-ready death: no quarantine — crash-loop counting only charges
+    incarnations that never served."""
+    h = _Harness()
+    sup = ReplicaSupervisor(
+        1, h.spawn, h.ready_check, backoff_base_s=0.005,
+        backoff_max_s=0.02, quarantine_after=3, poll_interval_s=0.002,
+    )
+    sup.start()
+    try:
+        for inc in (0, 1):  # two strikes
+            _wait(lambda i=inc: (0, i) in h.procs, msg=f"spawn {inc}")
+            h.proc(0, inc).die(1)
+            _wait(lambda i=inc: sup.spawns(0) == i + 2 or
+                  sup.state(0) == QUARANTINED, msg=f"respawn {inc + 1}")
+        assert sup.state(0) != QUARANTINED
+        h.mark_ready(0, 2)
+        _wait(lambda: sup.state(0) == READY, msg="READY on third try")
+        h.proc(0, 2).die(-9)  # post-ready death: NOT a strike
+        _wait(lambda: sup.spawns(0) == 4, msg="respawn after ready death")
+        assert sup.state(0) in (SPAWNING, BACKOFF)
+    finally:
+        sup.stop()
+    assert not _events(mem_sink, "replica_quarantined")
+    deaths = _events(mem_sink, "replica_dead")
+    assert [e["was_ready"] for e in deaths] == [False, False, True]
+
+
+def test_supervisor_stop_terminates_live_replicas():
+    """stop() joins the monitor (bounded, CC05) and terminates every
+    live fake process."""
+    h = _Harness()
+    sup = ReplicaSupervisor(
+        2, h.spawn, h.ready_check, poll_interval_s=0.005,
+    )
+    sup.start()
+    h.mark_ready(0, 0)
+    h.mark_ready(1, 0)
+    _wait(lambda: all(s == READY for s in sup.states().values()),
+          msg="both READY")
+    sup.stop(timeout=10.0)
+    assert h.proc(0, 0).returncode == -15
+    assert h.proc(1, 0).returncode == -15
+    assert sup._thread is None
+
+
+# ---- router admission (no replicas attached) --------------------------------
+
+
+def test_router_admission_queue_then_shed_then_dup(mem_sink):
+    from pyrecover_tpu.serving.fleet.router import FleetRouter
+
+    router = FleetRouter(max_inflight=8, max_queue=1)
+    req = {"rid": "r-0", "prompt": [1, 2], "max_new_tokens": 2}
+    assert router.submit(req) == "queued"  # no replicas: waits
+    assert router.submit(dict(req)) == "dup"  # deterministic rid dedup
+    assert router.submit(
+        {"rid": "r-1", "prompt": [3], "max_new_tokens": 1}) == "shed"
+    shed = [e for e in mem_sink.events if e["event"] == "fleet_shed"]
+    assert [e["rid"] for e in shed] == ["r-1"]
+    assert shed[0]["replicas"] == 0 and shed[0]["queued"] == 1
+    acc = router.accounting()
+    assert acc == {
+        "submitted": 2, "done": 0, "shed": 1, "queued": 1, "inflight": 0,
+        "redriven": 0, "redriven_rids": 0,
+    }
+    router.close()
+
+
+# ---- loadgen satellites -----------------------------------------------------
+
+
+def test_split_workload_is_an_exact_partition_of_the_poisson_process():
+    """targets=N yields N streams whose union, resorted by arrival,
+    is EXACTLY the single-stream process — same rids, same arrivals,
+    same payloads; no request duplicated, dropped, or re-timed."""
+    from pyrecover_tpu.serving.loadgen import open_loop_workload
+
+    kw = dict(vocab_size=64, max_model_len=96, seed=7, arrival_rate=200.0)
+    single = open_loop_workload(1.0, **kw)
+    streams = open_loop_workload(1.0, targets=3, **kw)
+    assert len(streams) == 3
+    assert sum(len(s) for s in streams) == len(single)
+    merged = sorted(
+        (r for s in streams for r in s), key=lambda r: r["arrival_s"])
+    assert merged == single
+    rids = [r["rid"] for s in streams for r in s]
+    assert len(set(rids)) == len(rids)
+    # determinism: the same seed re-splits identically
+    assert open_loop_workload(1.0, targets=3, **kw) == streams
+
+
+def test_request_ids_are_deterministic_and_distinct():
+    from pyrecover_tpu.serving.loadgen import request_id
+
+    assert request_id(3, 11) == request_id(3, 11)
+    assert request_id(3, 11) != request_id(3, 12)
+    assert request_id(3, 11) != request_id(4, 11)
+    assert isinstance(request_id(0, 0), str)
+
+
+# ---- engine satellite: submit-after-stop is loud ----------------------------
+
+
+def test_submit_after_stop_raises_typed_error():
+    """A stopped engine refuses new work with EngineStoppedError (the
+    router's redrive signal) instead of queueing it forever; reopen()
+    re-arms manual pumping."""
+    import jax
+
+    from pyrecover_tpu.serving.engine import (
+        EngineStoppedError,
+        ServingEngine,
+    )
+    from pyrecover_tpu.serving.hotswap.drill import (
+        _drill_model_config,
+        _serving_config,
+    )
+    from pyrecover_tpu.train_state import create_train_state
+    from pyrecover_tpu.optim import build_optimizer
+    from pyrecover_tpu.config import TrainConfig
+
+    cfg = _drill_model_config()
+    optimizer, _ = build_optimizer(TrainConfig())
+    state = create_train_state(jax.random.key(0), cfg, optimizer)
+    engine = ServingEngine(state.params, cfg, _serving_config())
+    engine.start()
+    engine.stop()
+    with pytest.raises(EngineStoppedError):
+        engine.submit([1, 2, 3], 2)
+    engine.reopen()
+    rid = engine.submit([1, 2, 3], 2)
+    engine.run_until_drained()
+    assert engine.result(rid) is not None
+
+
+# ---- catalog pin ------------------------------------------------------------
+
+
+def test_fleet_events_are_cataloged():
+    """Every fleet event has an emit site AND entries in BOTH catalogs
+    (telemetry docstring + README event table — the shared
+    obscheck-model pin, see conftest.assert_observed)."""
+    from conftest import assert_observed
+
+    assert_observed(
+        events=("replica_spawned", "replica_dead", "replica_quarantined",
+                "request_redriven", "fleet_shed", "canary_verdict"),
+    )
+    readme = (REPO / "README.md").read_text()
+    assert "## Serving fleet" in readme
+    # cross-links the satellite demands
+    assert "#serving-fleet" in readme
+    assert "--fleet-smoke" in readme

@@ -1,6 +1,6 @@
 """Decode/serving throughput bench (BENCH JSON contract).
 
-Three modes, all printing exactly ONE JSON line on stdout:
+Five modes, all printing exactly ONE JSON line on stdout:
 
   * default — the lockstep steady-state decode number (unchanged
     contract: two timed generations with identical prefill, their
@@ -23,6 +23,14 @@ Three modes, all printing exactly ONE JSON line on stdout:
     across the swap window) followed by the SIGKILL-mid-swap chaos
     drill (restart serves the old manifest, pin-guarded GC, zero torn
     state). Exit 1 on any violation.
+  * ``--fleet-smoke DIR`` — the format.sh serving-fleet gate
+    (``pyrecover_tpu/serving/fleet/drill.py``): the replica-loss chaos
+    drill (two subprocess replicas under open-loop load, SIGKILL one
+    mid-flight, assert redrive with zero silent losses, bounded p99,
+    supervisor respawn, crash-loop quarantine) followed by the
+    canary-rollback drill (divergent manifest fails the token gate and
+    rolls back pinned; healthy manifest waves). Exit 1 on any
+    violation.
 
 Run (tunnel up): python tools/bench_decode.py [--serving] [--batch 8] ...
 """
@@ -188,6 +196,9 @@ def main():
     ap.add_argument("--hotswap-smoke", metavar="DIR", default=None,
                     help="format.sh hot-swap gate: train-and-serve smoke "
                     "+ SIGKILL-mid-swap chaos drill")
+    ap.add_argument("--fleet-smoke", metavar="DIR", default=None,
+                    help="format.sh serving-fleet gate: replica-loss "
+                    "chaos drill + canary-rollback drill")
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--arrival-rate", type=float, default=100.0)
@@ -216,6 +227,14 @@ def main():
         report = hotswap_smoke(work, seed=args.seed)
         report["chaos"] = hotswap_chaos_drill(work, seed=args.seed)
         print(json.dumps({"metric": "hotswap_smoke", "ok": True,
+                          **report}, default=str))
+        return
+
+    if args.fleet_smoke is not None:
+        from pyrecover_tpu.serving.fleet.drill import fleet_smoke
+
+        report = fleet_smoke(Path(args.fleet_smoke), seed=args.seed)
+        print(json.dumps({"metric": "fleet_smoke", "ok": True,
                           **report}, default=str))
         return
 

@@ -376,6 +376,69 @@ def aggregate(events):
         }
     agg["hotswap"] = hotswap
 
+    # fleet rollup: the front door's trail over the merged per-replica
+    # shards — supervision (spawns/deaths/quarantines), the redrive and
+    # shed ledgers, per-replica vs fleet request latency (request_done
+    # events tagged `replica` by the drill's shard merge), and the
+    # canary rollout verdict trail (README "Serving fleet")
+    spawned = by.get("replica_spawned", [])
+    replica_deaths = by.get("replica_dead", [])
+    quarantines = by.get("replica_quarantined", [])
+    redrives = by.get("request_redriven", [])
+    shed = by.get("fleet_shed", [])
+    verdicts = by.get("canary_verdict", [])
+    fleet = {}
+    if spawned or replica_deaths or quarantines or redrives or shed \
+            or verdicts:
+        per_replica = {}
+        for e in done:
+            # obscheck: disable-next=consumer-field-drift -- "replica" is
+            # stamped by the fleet drill's shard merge (each replica's
+            # request_done inherits its shard's slot), not by the
+            # engine's emit site; absent on single-engine streams
+            r = e.get("replica")
+            if r is None or not isinstance(e.get("e2e_s"), (int, float)):
+                continue
+            per_replica.setdefault(int(r), []).append((float(e["e2e_s"]), 1))
+        fleet_samples = [s for v in per_replica.values() for s in v]
+
+        def _e2e_pct(samples):
+            return {
+                label: (
+                    round(_wpercentile(samples, q), 6) if samples else None
+                )
+                for label, q in (("p50", 0.50), ("p95", 0.95),
+                                 ("p99", 0.99))
+            }
+
+        replica_done = sum(len(v) for v in per_replica.values())
+        replicas_seen = sorted(
+            {int(e["replica"]) for e in spawned
+             if isinstance(e.get("replica"), int)} | set(per_replica)
+        )
+        fleet = {
+            "replicas_seen": replicas_seen,
+            "spawns": len(spawned),
+            "deaths": len(replica_deaths),
+            "quarantines": len(quarantines),
+            "redrives": len(redrives),
+            "shed": len(shed),
+            "shed_rate_pct": round(
+                100.0 * len(shed) / (replica_done + len(shed)), 2
+            ) if (replica_done + len(shed)) else 0.0,
+            "requests_done": replica_done,
+            "e2e_s": _e2e_pct(fleet_samples),
+            "per_replica_e2e_s": {
+                str(r): _e2e_pct(v) for r, v in sorted(per_replica.items())
+            },
+            "canary_verdicts": [
+                {"verdict": e.get("verdict"), "reason": e.get("reason"),
+                 "manifest": e.get("manifest"), "waved": e.get("waved")}
+                for e in verdicts
+            ],
+        }
+    agg["fleet"] = fleet
+
     # checkpoint-policy (autopilot) rollup + the static-policy
     # counterfactual: replay the SAME event stream against the configured
     # static interval — saves it would have paid (interval-spaced at the
@@ -779,6 +842,33 @@ def render(agg, out=None):
               f"swap window\n")
         for r in hs.get("rejected_reasons", []):
             w(f"  REJECTED           {r['path']}: {r['reason']}\n")
+    fl = agg.get("fleet") or {}
+    if fl:
+        w("\n-- serving fleet (front door) ----------------------------------\n")
+        w(f"  replicas           {len(fl['replicas_seen'])} seen "
+          f"({', '.join(str(r) for r in fl['replicas_seen'])}) — "
+          f"{fl['spawns']} spawn(s), {fl['deaths']} death(s), "
+          f"{fl['quarantines']} quarantine(s)\n")
+        w(f"  redrives           {fl['redrives']} request(s) redriven "
+          f"across replica deaths (zero silent losses by accounting)\n")
+        w(f"  shed               {fl['shed']} request(s) — "
+          f"{fl['shed_rate_pct']:.2f}% of admitted traffic\n")
+        p = fl.get("e2e_s") or {}
+        if p.get("p50") is not None:
+            w(f"  fleet e2e          p50 {p['p50'] * 1e3:9.2f}ms  "
+              f"p95 {p['p95'] * 1e3:9.2f}ms  "
+              f"p99 {p['p99'] * 1e3:9.2f}ms "
+              f"({fl['requests_done']} request(s))\n")
+        for rid_, rp in sorted(fl.get("per_replica_e2e_s", {}).items()):
+            if rp.get("p50") is None:
+                continue
+            w(f"    replica {rid_:<8} p50 {rp['p50'] * 1e3:9.2f}ms  "
+              f"p95 {rp['p95'] * 1e3:9.2f}ms  "
+              f"p99 {rp['p99'] * 1e3:9.2f}ms\n")
+        for v in fl.get("canary_verdicts", []):
+            tail = f" ({v['reason']})" if v.get("reason") else ""
+            w(f"  canary             {v['verdict'].upper()}{tail} — "
+              f"{v.get('manifest')}, waved {v.get('waved')}\n")
     al = agg.get("alerts") or {}
     if al.get("events"):
         w("\n-- SLO alerts (exporter burn-rate rules) -----------------------\n")
@@ -847,6 +937,7 @@ def main(argv=None):
                 "autopilot": agg["autopilot"],
                 "serving": agg["serving"],
                 "hotswap": agg["hotswap"],
+                "fleet": agg["fleet"],
                 "alerts": agg["alerts"],
                 "data_stalls": agg["data_stalls"],
                 "preempt": agg["preempt"],
