@@ -472,8 +472,16 @@ fi
 # spawns. (b) canary rollback — a divergent manifest fails the canary
 # token gate, auto-rolls-back to the pin-leased old manifest on every
 # replica (probe equal to a cold restore), and a healthy manifest
-# waves with zero rejections. The merged per-replica telemetry is then
-# fed to summarize_telemetry, which must render the fleet section.
+# waves with zero rejections. The chaos drill also gates the
+# distributed-tracing contract: every completed request (baseline AND
+# kill phase) assembles into exactly ONE rooted trace with zero orphan
+# spans across the parent + replica shards, the SIGKILL-redriven
+# request's trace links BOTH attempts under one root with the kill
+# hole attributed to redrive_gap, and every complete trace's bucket
+# sum stays inside the named residual tolerance. The merged
+# per-replica telemetry is then fed to summarize_telemetry (fleet +
+# request-tracing sections must render) and to tools/tracepath.py
+# --expect-complete (the CI trace-assembly gate).
 FLEETSMOKE_WORK="${FLEETSMOKE_WORK:-/tmp/pyrecover_fleet_smoke}"
 rm -rf "$FLEETSMOKE_WORK"
 if FS_OUT=$(JAX_PLATFORMS=cpu python tools/bench_decode.py \
@@ -500,6 +508,13 @@ assert ca["divergent_verdict"] == "fail" \
     f"divergent manifest leaked past the canary gate: {ca}"
 assert ca["healthy_verdict"] == "pass" and ca["healthy_waved"] >= 1, \
     f"healthy rollout did not wave: {ca}"
+assert ch["trace_assembled"] > 0, f"no request traces assembled: {ch}"
+assert ch["trace_orphans"] == 0, \
+    f"trace assembly left orphan spans: {ch}"
+assert ch["trace_redriven_linked"] >= 1 and ch["trace_redrive_gap_s"] > 0, \
+    f"redriven request's attempts not linked under one root: {ch}"
+assert ch["trace_residual_violations"] == 0, \
+    f"critical-path buckets do not sum to e2e within tolerance: {ch}"
 print(f"fleet smoke: OK — chaos: {ch['replicas']} replicas, "
       f"{ch['requests']} requests, kill rc {ch['killed_rc']}, "
       f"{ch['redriven']} redriven, p99 {ch['kill_p99_s']}s <= gate "
@@ -508,7 +523,11 @@ print(f"fleet smoke: OK — chaos: {ch['replicas']} replicas, "
       f"{ch['quarantine_spawns']} spawns; canary: divergent "
       f"{ca['divergent_verdict']} ({ca['divergent_reason']}) -> rolled "
       f"back, healthy {ca['healthy_verdict']} waved "
-      f"{ca['healthy_waved']} replica(s)")
+      f"{ca['healthy_waved']} replica(s); tracing: "
+      f"{ch['trace_assembled']} trace(s) assembled "
+      f"({ch['trace_completed']} completed, {ch['trace_orphans']} "
+      f"orphans), redrive gap {ch['trace_redrive_gap_s']}s, tail "
+      f"dominated by {ch['trace_dominant_tail_bucket']}")
 PYEOF
 else
   echo "$FS_OUT"
@@ -524,8 +543,30 @@ if FS_SUM=$(JAX_PLATFORMS=cpu python tools/summarize_telemetry.py \
     echo "summarize_telemetry: serving-fleet section missing"
     rc=1
   fi
+  # the request-tracing section must render with nonzero assembled
+  # traces and zero orphan spans over the merged drill shard
+  if echo "$FS_SUM" | grep -q "request tracing (cross-process)" \
+      && echo "$FS_SUM" | grep -Eq "(^| )0 orphan span" \
+      && echo "$FS_SUM" | grep -Eq "[1-9][0-9]* assembled"; then
+    echo "$FS_SUM" | grep -A 4 "request tracing (cross-process)" | head -5
+  else
+    echo "summarize_telemetry: request-tracing section missing/empty"
+    rc=1
+  fi
 else
   echo "$FS_SUM"
+  rc=1
+fi
+# tracepath CLI over the same merged shard: the trace-assembly CI gate
+# (exit 1 on any orphan span, zero assembled traces, or a complete
+# trace outside the residual tolerance)
+if TP_OUT=$(JAX_PLATFORMS=cpu python tools/tracepath.py \
+    "$FLEETSMOKE_WORK/chaos/fleet_telemetry.jsonl" \
+    --json "$FLEETSMOKE_WORK/tracepath.json" --expect-complete 2>&1); then
+  echo "$TP_OUT" | head -6
+else
+  echo "$TP_OUT"
+  echo "tracepath: trace-assembly gate failed"
   rc=1
 fi
 

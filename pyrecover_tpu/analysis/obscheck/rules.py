@@ -24,9 +24,14 @@ top / exporter reads) must agree; each OB rule checks one edge:
 * **OB06 metric-name-drift** — the exporter/aggregator/top consume a
   metric series never registered (literal, alias, tuple-loop, or
   f-string-wildcard site).
+* **OB07 untraced-request-span** — a span site in request-handling code
+  (it passes ``rid=``) with neither an explicit ``trace=`` field nor an
+  enclosing ``tracing.installed(...)`` context: the span is an orphan
+  by construction — ``traceassembly`` can never attach it to its
+  request's root.
 
-Cross-surface rules (all but OB05) arm only when the docstring catalog
-module is part of the scan — see ``model.py``.
+Cross-surface rules (all but OB05 and OB07) arm only when the docstring
+catalog module is part of the scan — see ``model.py``.
 """
 
 import dataclasses
@@ -258,6 +263,44 @@ def check_metric_drift(model, config):
             r, read.module, read.node,
             f'series "{read.name}" is consumed but never registered as '
             f"a counter/gauge/histogram",
+        )
+
+
+@rule(
+    "OB07", "untraced-request-span", "error",
+    "request-path span without trace-context installation",
+)
+def check_untraced_request_span(model, config):
+    import ast as _ast
+
+    r = OB_RULES["untraced-request-span"]
+    for site in model.spans:
+        keywords = getattr(site.node, "keywords", [])
+        if not any(kw.arg == "rid" for kw in keywords):
+            continue  # not a per-request span
+        if any(kw.arg == "trace" for kw in keywords):
+            continue  # trace context passed explicitly
+        installed = False
+        for anc in site.module.ancestors(site.node):
+            if not isinstance(anc, (_ast.With, _ast.AsyncWith)):
+                continue
+            for item in anc.items:
+                expr = item.context_expr
+                if not isinstance(expr, _ast.Call):
+                    continue
+                fn = expr.func
+                name = (fn.attr if isinstance(fn, _ast.Attribute)
+                        else getattr(fn, "id", None))
+                if name in ("installed", "trace_context"):
+                    installed = True
+        if installed:
+            continue
+        yield finding(
+            r, site.module, site.node,
+            f'span "{site.name}" carries rid= but neither an explicit '
+            f"trace= field nor an enclosing tracing.installed(...) — "
+            f"an orphan by construction, unattachable to its request's "
+            f"trace",
         )
 
 

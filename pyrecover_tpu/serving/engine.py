@@ -65,7 +65,7 @@ from pyrecover_tpu.serving.kvpool import (
     make_block_table,
 )
 from pyrecover_tpu.serving.paged import paged_forward
-from pyrecover_tpu.telemetry import metrics
+from pyrecover_tpu.telemetry import metrics, tracing
 
 # request lifecycle
 QUEUED, PREFILL, RUNNING, DONE = "queued", "prefill", "running", "done"
@@ -131,6 +131,11 @@ class Request:
     t_first_token: float = None
     t_done: float = None
     backpressure_noted: bool = False
+    # distributed trace context captured at submit() (the fleet replica
+    # installs it on the reader thread); carried on the request because
+    # completion spans emit from the PUMP thread, where no thread-local
+    # installation could reach them
+    trace: object = None
 
     @property
     def n_new(self):
@@ -265,7 +270,7 @@ class ServingEngine:
         req = Request(
             rid=-1, prompt=prompt, max_new_tokens=int(max_new_tokens),
             eos_id=eos_id, tokens=list(prompt),
-            t_submit=time.monotonic(),
+            t_submit=time.monotonic(), trace=tracing.current(),
         )
         with self._lock:
             if self._closed:
@@ -307,6 +312,7 @@ class ServingEngine:
     def _apply_staged_swap(self):
         """Step-boundary flip (pump thread only): consume the staged
         weights and emit ``weights_swap_done`` once they are live."""
+        t_flip = time.monotonic()
         with self._lock:
             staged, self._staged_swap = self._staged_swap, None
         if staged is None:
@@ -315,12 +321,26 @@ class ServingEngine:
         self.weights_step = staged["step"]
         info = staged["info"]
         t_begin = info.pop("t_begin", staged["t_staged"])
+        t_live = time.monotonic()
+        in_flight = [s for s in self._slots if s is not None]
         telemetry.emit(
             "weights_swap_done", step=staged["step"],
-            swap_s=round(time.monotonic() - t_begin, 6),
-            in_flight=sum(1 for s in self._slots if s is not None),
+            swap_s=round(t_live - t_begin, 6),
+            in_flight=len(in_flight),
             **info,
         )
+        # the swap window as each in-flight request experienced it: a
+        # `swap_stall` child span under the request's dispatch attempt,
+        # so trace assembly can attribute mid-generation stall to the
+        # swap instead of inflating its decode bucket
+        for req in in_flight:
+            if req.trace is not None:
+                telemetry.record_span(
+                    "swap_stall", t_flip, t_live,
+                    parent=req.trace.span, trace=req.trace.trace,
+                    attempt=req.trace.attempt, rid=req.rid,
+                    step=staged["step"],
+                )
         metrics.counter("weights_swaps_total").inc()
         return True
 
@@ -630,18 +650,19 @@ class ServingEngine:
         e2e = req.t_done - req.t_submit
         metrics.histogram("tpot_s").observe(tpot)
         metrics.histogram("e2e_s").observe(e2e)
-        telemetry.record_span(
-            "req_queue", req.t_submit, req.t_admit, rid=req.rid,
-        )
-        telemetry.record_span(
-            "req_prefill", req.t_admit, req.t_first_token, rid=req.rid,
-        )
-        telemetry.record_span(
-            "req_decode", req.t_first_token, req.t_done, rid=req.rid,
-        )
-        telemetry.emit(
-            "request_done", rid=req.rid, prompt_tokens=len(req.prompt),
-            new_tokens=req.n_new, blocks_released=released,
-            ttft_s=round(ttft, 6), tpot_s=round(tpot, 6),
-            e2e_s=round(e2e, 6),
-        )
+        with tracing.installed(req.trace):
+            telemetry.record_span(
+                "req_queue", req.t_submit, req.t_admit, rid=req.rid,
+            )
+            telemetry.record_span(
+                "req_prefill", req.t_admit, req.t_first_token, rid=req.rid,
+            )
+            telemetry.record_span(
+                "req_decode", req.t_first_token, req.t_done, rid=req.rid,
+            )
+            telemetry.emit(
+                "request_done", rid=req.rid, prompt_tokens=len(req.prompt),
+                new_tokens=req.n_new, blocks_released=released,
+                ttft_s=round(ttft, 6), tpot_s=round(tpot, 6),
+                e2e_s=round(e2e, 6),
+            )

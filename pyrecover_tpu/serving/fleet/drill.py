@@ -19,7 +19,14 @@ Two gates, both wired into ``format.sh`` through
     zeroed capacity sheds loudly (``fleet_shed`` per request, counted,
     never silent); a crash-looping replica (no checkpoint → rc 2) is
     quarantined after exactly ``quarantine_after`` spawns instead of
-    being restarted forever. Per-replica telemetry shards are merged
+    being restarted forever. The tracing plane is gated here too:
+    every completed request must assemble (via
+    :mod:`pyrecover_tpu.telemetry.traceassembly`) into exactly one
+    rooted skew-corrected trace with zero orphan spans, the redriven
+    request's trace must link BOTH attempts under one root with the
+    kill hole attributed to ``redrive_gap``, and the critical-path
+    buckets must sum to e2e inside the named residual tolerance.
+    Per-replica telemetry shards are merged
     (tagged by replica) with the parent's fleet events into one
     ``fleet_telemetry.jsonl`` for the summarizer, and the per-replica
     metrics exporters are scraped into one FleetAggregator snapshot.
@@ -47,6 +54,7 @@ from pathlib import Path
 
 from pyrecover_tpu import telemetry
 from pyrecover_tpu.resilience import faults
+from pyrecover_tpu.telemetry import traceassembly, tracing
 from pyrecover_tpu.serving.fleet.router import FleetRouter
 from pyrecover_tpu.serving.fleet.supervisor import (
     QUARANTINED,
@@ -108,7 +116,7 @@ class _Fleet:
     def __init__(self, exp, workdir, n_replicas, *, seed=0,
                  fault_plans=None, manifest=None, backoff_base_s=0.1,
                  backoff_max_s=1.0, quarantine_after=3, max_inflight=8,
-                 max_queue=256):
+                 max_queue=256, trace_epoch=""):
         self.exp = Path(exp)
         self.workdir = Path(workdir)
         self.workdir.mkdir(parents=True, exist_ok=True)
@@ -126,7 +134,8 @@ class _Fleet:
         self.status = {}      # (slot, incarnation) -> status path
         self.ready_info = {}  # slot -> latest ready record
         self.router = FleetRouter(
-            max_inflight=max_inflight, max_queue=max_queue)
+            max_inflight=max_inflight, max_queue=max_queue,
+            trace_epoch=trace_epoch)
         self.sup = ReplicaSupervisor(
             n_replicas, self._spawn, self._ready_check,
             on_ready=self._on_ready, backoff_base_s=backoff_base_s,
@@ -313,7 +322,8 @@ def _chaos_body(workdir, mem, *, n_replicas, seed, duration_s,  # jaxlint: host-
         )
 
     # ---- phase A: no-kill baseline fleet -----------------------------
-    fleet_a = _Fleet(exp, workdir / "fleet_a", n_replicas, seed=seed)
+    fleet_a = _Fleet(exp, workdir / "fleet_a", n_replicas, seed=seed,
+                     trace_epoch="a")
     fleet_a.start()
     acc_a = _run_open_loop(fleet_a.router, single, timeout_s=timeout_s)
     if acc_a["done"] != acc_a["submitted"] or acc_a["shed"]:
@@ -371,7 +381,7 @@ def _chaos_body(workdir, mem, *, n_replicas, seed, duration_s,  # jaxlint: host-
     }
     fleet_b = _Fleet(
         exp, workdir / "fleet_b", n_replicas, seed=seed,
-        fault_plans={(1, 0): kill_plan},
+        fault_plans={(1, 0): kill_plan}, trace_epoch="b",
     )
     # the parent's redrive seam: the first redrive hits a transient I/O
     # error and must retry through io_retry, never drop the request
@@ -465,12 +475,69 @@ def _chaos_body(workdir, mem, *, n_replicas, seed, duration_s,  # jaxlint: host-
         raise AssertionError("fleet drill: replica death went unobserved")
     fleet_b.stop()
 
+    # ---- trace completeness gate -------------------------------------
+    # Every completed request must assemble into exactly ONE rooted,
+    # skew-corrected trace with zero orphan spans; the redriven request
+    # must link BOTH attempts under one root with the kill hole
+    # attributed to redrive-gap; and the critical-path buckets must sum
+    # to e2e inside the named residual tolerance. Replica shards are
+    # durable here (both fleets stopped → sinks closed, per-event
+    # flush), so assembly sees the complete per-process evidence.
+    domains = [traceassembly.Domain("parent", list(mem.events))]
+    for fleet, tag in ((fleet_a, "fleet_a"), (fleet_b, "fleet_b")):
+        for slot in range(n_replicas):
+            events = telemetry.read_events(fleet.shards[slot])
+            if events:
+                domains.append(traceassembly.Domain(
+                    f"{tag}/replica_{slot}", events))
+    trace_report = traceassembly.assemble(domains)
+    per_trace = trace_report["per_trace"]
+    if trace_report["traces"]["orphan_spans"]:
+        raise AssertionError(
+            f"fleet drill: {trace_report['traces']['orphan_spans']} orphan "
+            f"span(s) detached from their request roots "
+            f"(e.g. {trace_report['orphans'][:3]})"
+        )
+    untraced = [
+        (epoch, rid)
+        for epoch, results in (("a", baseline), ("b", results_b))
+        for rid in results
+        if "e2e_s" not in per_trace.get(tracing.trace_id(rid, epoch), {})
+    ]
+    if untraced:
+        raise AssertionError(
+            f"fleet drill: {len(untraced)} completed request(s) have no "
+            f"completed trace (e.g. {untraced[:3]})"
+        )
+    redriven_rids = sorted({e["rid"] for e in redriven})
+    redrive_gap_s = 0.0
+    for rid in redriven_rids:
+        entry = per_trace[tracing.trace_id(rid, "b")]
+        gap = entry["buckets"]["redrive_gap"]
+        if entry["attempts"] < 2 or gap <= 0.0:
+            raise AssertionError(
+                f"fleet drill: redriven request {rid} trace does not link "
+                f"both attempts under one root with the kill hole in "
+                f"redrive-gap ({entry})"
+            )
+        redrive_gap_s = max(redrive_gap_s, gap)
+    residual_bad = [
+        e for e in per_trace.values()
+        if e.get("complete") and not e["residual_ok"]
+    ]
+    if residual_bad:
+        raise AssertionError(
+            f"fleet drill: critical-path buckets do not sum to e2e within "
+            f"the named residual tolerance for {len(residual_bad)} "
+            f"trace(s) (e.g. {residual_bad[:2]})"
+        )
+
     # ---- phase C: crash-looper is quarantined, not restarted forever -
     empty = workdir / "empty_exp"
     empty.mkdir(parents=True, exist_ok=True)
     fleet_c = _Fleet(
         empty, workdir / "fleet_c", 1, seed=seed, backoff_base_s=0.05,
-        backoff_max_s=0.2, quarantine_after=3,
+        backoff_max_s=0.2, quarantine_after=3, trace_epoch="c",
     )
     fleet_c.sup.start()
     deadline = time.monotonic() + _READY_TIMEOUT_S
@@ -505,6 +572,13 @@ def _chaos_body(workdir, mem, *, n_replicas, seed, duration_s,  # jaxlint: host-
         "respawns": len(spawned) - 1,
         "quarantine_spawns": spawns,
         "aggregator_targets": len(snap["targets"]),
+        "trace_assembled": trace_report["traces"]["assembled"],
+        "trace_completed": trace_report["traces"]["completed"],
+        "trace_orphans": trace_report["traces"]["orphan_spans"],
+        "trace_redriven_linked": len(redriven_rids),
+        "trace_redrive_gap_s": round(redrive_gap_s, 4),
+        "trace_residual_violations": len(residual_bad),
+        "trace_dominant_tail_bucket": trace_report["dominant_tail_bucket"],
     }
 
 
@@ -541,7 +615,8 @@ def canary_rollout_drill(workdir, *, seed=0, timeout_s=240.0):  # jaxlint: host-
                 "canary drill: releases serve identical probe tokens")
 
         fleet = _Fleet(
-            exp, workdir / "fleet", 2, seed=seed, manifest=m_old)
+            exp, workdir / "fleet", 2, seed=seed, manifest=m_old,
+            trace_epoch="canary")
         fleet.start()
         pre = fleet.probe(0)
         if pre["tokens"] != probe_old:

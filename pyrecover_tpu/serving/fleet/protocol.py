@@ -20,17 +20,27 @@ collective. One :class:`Connection` wraps one socket end:
 Message schema (informal; values are JSON scalars/arrays):
 
   router → replica
-    {"type": "submit", "rid", "prompt", "max_new_tokens"}
+    {"type": "submit", "rid", "prompt", "max_new_tokens" [, "trace"]}
     {"type": "probe", "seed"}
     {"type": "swap", "manifest"}
     {"type": "status"}
     {"type": "shutdown"}
   replica → router
-    {"type": "done", "rid", "tokens"}
+    {"type": "done", "rid", "tokens" [, "trace"]}
     {"type": "probe_result", "tokens", "e2e_s"}
     {"type": "swap_result", "ok", "step", "reason"}
     {"type": "status_result", "pending", "completed", "loaded_step",
      "rejected"}
+
+The optional ``trace`` field is the distributed-trace context envelope
+(:mod:`pyrecover_tpu.telemetry.tracing`): ``{"trace": <16-hex id>,
+"span": <attempt span id>, "attempt": <1-based dispatch attempt>}``.
+The router stamps it onto ``submit`` at dispatch, the replica installs
+it around the engine submission and echoes it on ``done``; peers that
+do not understand it ignore it (``tracing.from_wire`` decodes absent or
+malformed context to None). Both ends also emit ``fleet_send`` /
+``fleet_recv`` markers at the socket edge — the anchor pairs trace
+assembly aligns genuinely different process clocks with.
 """
 
 import json

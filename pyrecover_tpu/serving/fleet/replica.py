@@ -41,6 +41,7 @@ from pathlib import Path
 from pyrecover_tpu import telemetry
 from pyrecover_tpu.resilience import faults
 from pyrecover_tpu.serving.fleet.protocol import Connection
+from pyrecover_tpu.telemetry import tracing
 
 _PROBE_TIMEOUT_S = 120.0
 
@@ -54,6 +55,7 @@ class _ReplicaState:
         self.replica_id = replica_id
         self.lock = threading.Lock()
         self.outstanding = {}  # engine rid -> fleet rid
+        self.traces = {}       # engine rid -> wire TraceContext | None
         self.completed = 0
         self.stop = threading.Event()
 
@@ -85,9 +87,22 @@ def _handle(msg, conn, *, state, engine, swapper, probe_seed):  # jaxlint: host-
 
     kind = msg.get("type")
     if kind == "submit":
-        erid = engine.submit(msg["prompt"], msg["max_new_tokens"])
+        # decode + install the wire trace context: the socket-edge
+        # fleet_recv marker pairs with the router's fleet_send for skew
+        # alignment, and the installed context makes the engine's
+        # buffered req_* spans children of this dispatch attempt
+        ctx = tracing.from_wire(msg.get("trace"))
+        if ctx is not None:
+            telemetry.emit(
+                "fleet_recv", rid=msg["rid"], kind="submit",
+                attempt=ctx.attempt, trace=ctx.trace,
+                mono=round(time.monotonic(), 6),
+            )
+        with tracing.installed(ctx):
+            erid = engine.submit(msg["prompt"], msg["max_new_tokens"])
         with state.lock:
             state.outstanding[erid] = msg["rid"]
+            state.traces[erid] = ctx
     elif kind == "probe":
         probe = _probe_workload(int(msg.get("seed", probe_seed)))
         tokens, e2e = _probe_with_latency(engine, probe)
@@ -129,15 +144,27 @@ def _completer(state, engine, conn, conn_done):  # jaxlint: host-only
             with state.lock:
                 state.completed += 1
                 completed = state.completed
+                ctx = state.traces.get(erid)
             faults.check(
                 "replica_kill", replica=state.replica_id, written=completed,
             )
+            # marker AFTER the kill seam: a killed request leaves no
+            # done-side send, so its wire legs stay honestly unpaired
+            msg = {"type": "done", "rid": rid, "tokens": tokens}
+            if ctx is not None:
+                telemetry.emit(
+                    "fleet_send", rid=rid, kind="done",
+                    attempt=ctx.attempt, trace=ctx.trace,
+                    mono=round(time.monotonic(), 6),
+                )
+                msg["trace"] = ctx.to_wire()
             try:
-                conn.send({"type": "done", "rid": rid, "tokens": tokens})
+                conn.send(msg)
             except OSError:
                 return  # router gone; the connection loop winds down
             with state.lock:
                 state.outstanding.pop(erid, None)
+                state.traces.pop(erid, None)
         time.sleep(0.002)
 
 

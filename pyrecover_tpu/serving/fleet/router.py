@@ -23,10 +23,21 @@ or explicitly shed — never silently dropped:
   the ``router_redrive`` fault seam — an injected transient I/O error
   retries with backoff, it never drops the request. Duplicate ``done``
   frames (a replica that finished just as we redrove) dedup by rid.
+* **Tracing**: the router is the trace authority. Admission mints a
+  deterministic per-request trace (``tracing.mint``, ``trace_root``
+  event); each dispatch stamps an attempt context onto the wire frame
+  (``fleet_send`` marker at the socket edge) and completion/redrive
+  retroactively records the attempt span plus — at completion — the
+  ``req_root`` span, so a redriven request's attempts all hang under
+  one root. After a successful ``drain()`` the router marks tail
+  exemplars (``trace_exemplar``: every redriven/shed rid plus the
+  p99-slowest), which trace assembly uses to keep full trees for the
+  interesting requests and counts-only for the rest.
 
 Single structural lock (``_lock``) guards all tables; socket work
-(connect, send) happens outside it (CC02). Reader threads live in
-:class:`protocol.Connection`; ``close()`` bounds every join (CC05).
+(connect, send) and every telemetry emit happen outside it (CC02).
+Reader threads live in :class:`protocol.Connection`; ``close()`` bounds
+every join (CC05).
 """
 
 import threading
@@ -37,6 +48,7 @@ from pyrecover_tpu import telemetry
 from pyrecover_tpu.resilience import faults
 from pyrecover_tpu.resilience.retry import io_retry
 from pyrecover_tpu.serving.fleet import protocol
+from pyrecover_tpu.telemetry import tracing
 
 _REPLY_TYPES = ("probe_result", "swap_result", "status_result")
 
@@ -44,10 +56,15 @@ _REPLY_TYPES = ("probe_result", "swap_result", "status_result")
 class FleetRouter:
     """Route requests across replica connections; see module docstring."""
 
-    def __init__(self, *, max_inflight=8, max_queue=256, affinity=False):
+    def __init__(self, *, max_inflight=8, max_queue=256, affinity=False,
+                 trace_epoch=""):
         self.max_inflight = int(max_inflight)
         self.max_queue = int(max_queue)
         self.affinity = bool(affinity)
+        # deterministic trace-id qualifier: distinct router deployments
+        # replaying the same workload (the drill's baseline vs kill
+        # phases) mint distinct traces in a merged stream
+        self.trace_epoch = str(trace_epoch)
         self._lock = threading.Lock()
         # every table below is guarded by _lock
         self._links = {}        # replica_id -> Connection
@@ -61,6 +78,8 @@ class FleetRouter:
         self._t_submit = {}     # rid -> monotonic submit time
         self._t_done = {}       # rid -> monotonic done time
         self._waiters = {}      # replica_id -> {reply_type: (Event, box)}
+        self._trace = {}        # rid -> {trace, attempt, t_dispatch}
+        self._exemplars = set()  # rids already marked trace_exemplar
 
     # ---- replica attachment ----------------------------------------------
 
@@ -93,11 +112,15 @@ class FleetRouter:
         rid = req["rid"]
         sends = []
         shed_ctx = None
+        t_sub = time.monotonic()
+        tid = tracing.trace_id(rid, self.trace_epoch)
         with self._lock:
             if rid in self._requests:
                 return "dup"
             self._requests[rid] = req
-            self._t_submit[rid] = time.monotonic()
+            self._t_submit[rid] = t_sub
+            self._trace[rid] = {
+                "trace": tid, "attempt": 0, "t_dispatch": None}
             target = self._pick_target_locked(req)
             if target is not None:
                 self._dispatch_locked(rid, target, sends)
@@ -115,6 +138,11 @@ class FleetRouter:
                     "replicas": len(self._links),
                 }
                 verdict = "shed"
+        telemetry.emit(
+            "trace_root", rid=rid, trace=tid,
+            span=tracing.root_span_id(tid), verdict=verdict,
+            mono=round(t_sub, 6),
+        )
         if shed_ctx is not None:
             telemetry.emit("fleet_shed", rid=rid, **shed_ctx)
         self._send_all(sends)
@@ -142,10 +170,20 @@ class FleetRouter:
         req = self._requests[rid]
         self._owner[rid] = target
         self._outstanding[target].add(rid)
-        sends.append((target, {
+        msg = {
             "type": "submit", "rid": rid, "prompt": req["prompt"],
             "max_new_tokens": req["max_new_tokens"],
-        }))
+        }
+        tr = self._trace.get(rid)
+        if tr is not None:
+            tr["attempt"] += 1
+            tr["t_dispatch"] = time.monotonic()
+            msg["trace"] = {
+                "trace": tr["trace"],
+                "span": tracing.attempt_span_id(tr["trace"], tr["attempt"]),
+                "attempt": tr["attempt"],
+            }
+        sends.append((target, msg))
 
     def _pump_locked(self, sends):
         while self._queue:
@@ -169,6 +207,15 @@ class FleetRouter:
             if conn is None:
                 self._on_disconnect(target)
                 continue
+            if msg.get("type") == "submit" and "trace" in msg:
+                # socket-edge marker: one half of the skew anchor pair
+                # trace assembly aligns process clocks with
+                telemetry.emit(
+                    "fleet_send", rid=msg["rid"], kind="submit",
+                    attempt=msg["trace"]["attempt"],
+                    trace=msg["trace"]["trace"],
+                    mono=round(time.monotonic(), 6),
+                )
             try:
                 conn.send(msg)
             except OSError:
@@ -190,15 +237,42 @@ class FleetRouter:
 
     def _on_done(self, replica_id, msg):  # jaxlint: host-only
         rid = msg.get("rid")
+        t_recv = time.monotonic()
         sends = []
+        finished = None
         with self._lock:
             self._outstanding.get(replica_id, set()).discard(rid)
             if rid in self._results or rid not in self._requests:
                 return  # duplicate done after a redrive raced completion
             self._results[rid] = msg.get("tokens")
-            self._t_done[rid] = time.monotonic()
+            self._t_done[rid] = t_recv
             self._owner.pop(rid, None)
+            tr = self._trace.get(rid)
+            if tr is not None and tr["attempt"]:
+                finished = (dict(tr), self._t_submit[rid],
+                            self._redrives.get(rid, 0))
             self._pump_locked(sends)
+        if finished is not None:
+            tr, t_sub, redrives = finished
+            tid = tr["trace"]
+            telemetry.emit(
+                "fleet_recv", rid=rid, kind="done",
+                attempt=tr["attempt"], trace=tid,
+                mono=round(t_recv, 6),
+            )
+            # retroactive attempt + root spans close the trace: every
+            # replica-side span parents under one of these attempt ids
+            telemetry.record_span(
+                "fleet_attempt", tr["t_dispatch"], t_recv,
+                span_id=tracing.attempt_span_id(tid, tr["attempt"]),
+                parent=tracing.root_span_id(tid), trace=tid,
+                attempt=tr["attempt"], rid=rid,
+            )
+            telemetry.record_span(
+                "req_root", t_sub, t_recv,
+                span_id=tracing.root_span_id(tid), trace=tid, rid=rid,
+                attempts=tr["attempt"], redrives=redrives,
+            )
         self._send_all(sends)
 
     def _on_disconnect(self, replica_id):  # jaxlint: host-only
@@ -217,12 +291,26 @@ class FleetRouter:
             self._redrive(rid, replica_id)
 
     def _redrive(self, rid, from_replica):  # jaxlint: host-only
+        t_now = time.monotonic()
         with self._lock:
             attempt = self._redrives.get(rid, 0) + 1
             self._redrives[rid] = attempt
+            tr = dict(self._trace.get(rid) or {})
+        if tr.get("attempt"):
+            # close the failed attempt's span so BOTH attempts of a
+            # redriven request link under the same root; the wall-clock
+            # hole between this close and the next attempt's fleet_send
+            # is what assembly attributes to `redrive-gap`
+            tid = tr["trace"]
+            telemetry.record_span(
+                "fleet_attempt", tr["t_dispatch"], t_now,
+                span_id=tracing.attempt_span_id(tid, tr["attempt"]),
+                parent=tracing.root_span_id(tid), trace=tid,
+                attempt=tr["attempt"], rid=rid, ok=False, redriven=True,
+            )
         telemetry.emit(
             "request_redriven", rid=rid, from_replica=from_replica,
-            attempt=attempt,
+            attempt=attempt, trace=tr.get("trace"),
         )
         # the redrive seam: an injected transient error retries with
         # capped backoff — a redriven request is never dropped
@@ -297,14 +385,50 @@ class FleetRouter:
                 for rid in self._results
             ]
 
+    def emit_trace_exemplars(self):  # jaxlint: host-only
+        """Tail-based exemplar marking: emit one ``trace_exemplar`` per
+        interesting rid — every redriven and shed request plus the
+        p99-slowest completions. Trace assembly keeps FULL trees only
+        for marked traces (counts-only for the rest). Idempotent per
+        rid, so repeated drains never duplicate markers."""
+        with self._lock:
+            lats = {
+                rid: self._t_done[rid] - self._t_submit[rid]
+                for rid in self._results
+            }
+            marks = {}  # rid -> (reason, e2e_s | None)
+            if lats:
+                vals = sorted(lats.values())
+                p99 = vals[min(len(vals) - 1, int(0.99 * len(vals)))]
+                for rid, e2e in lats.items():
+                    if e2e >= p99:
+                        marks[rid] = ("p99_tail", e2e)
+            for rid in self._shed:
+                marks[rid] = ("shed", None)
+            for rid in self._redrives:
+                if rid in lats:
+                    marks[rid] = ("redriven", lats[rid])
+            todo = sorted(set(marks) - self._exemplars)
+            self._exemplars.update(todo)
+            traces = {rid: t["trace"] for rid, t in self._trace.items()}
+        for rid in todo:
+            reason, e2e = marks[rid]
+            telemetry.emit(
+                "trace_exemplar", rid=rid, trace=traces.get(rid),
+                reason=reason,
+                e2e_s=None if e2e is None else round(e2e, 6),
+            )
+
     def drain(self, timeout_s=120.0):  # jaxlint: host-only
-        """Block until every accepted (non-shed) request has a result."""
+        """Block until every accepted (non-shed) request has a result;
+        tail exemplars are marked once the stream is fully drained."""
         deadline = time.monotonic() + timeout_s
         while True:
             with self._lock:
                 missing = (
                     set(self._requests) - self._shed - set(self._results))
             if not missing:
+                self.emit_trace_exemplars()
                 return
             if time.monotonic() > deadline:
                 acc = self.accounting()

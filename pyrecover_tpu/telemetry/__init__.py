@@ -157,6 +157,27 @@ Core event names across the stack (fields beyond the envelope):
                       max_inflight AND the router queue full — the
                       shed is loud and counted, submitted == done +
                       shed stays exact)
+    trace_root        rid, trace, span, verdict, mono (the router minted
+                      a distributed trace at admission: trace is the
+                      deterministic 16-hex id from the content-derived
+                      rid (+ optional deployment epoch), span the
+                      ``<trace>:r`` root id — every cross-process span
+                      of this request hangs under it)
+    fleet_send        rid, kind, attempt, trace, mono (a traced frame
+                      left a process at the socket edge: kind "submit"
+                      on the router, kind "done" on the replica — one
+                      half of the skew-anchor pair traceassembly aligns
+                      process clocks with)
+    fleet_recv        rid, kind, attempt, trace, mono (the matching
+                      arrival edge: kind "submit" on the replica, kind
+                      "done" on the router — the other anchor half; a
+                      killed attempt honestly leaves its done legs
+                      unpaired)
+    trace_exemplar    rid, trace, reason, e2e_s (tail-based retention
+                      mark after a successful drain: reason is
+                      redriven|shed|p99_tail — traceassembly keeps the
+                      FULL trace tree only for marked requests,
+                      counts-only for the rest)
     canary_verdict    verdict, manifest, reason, canary, waved,
                       probe_p99_s, p99_gate_s (one canary rollout's
                       outcome: "pass" waved the manifest fleet-wide,
@@ -233,8 +254,12 @@ SLO burn-rate alert rules evaluated on the exporter's serve thread:
 ``tools/summarize_telemetry.py`` turns a run's JSONL into a goodput
 report; ``tools/traceview.py`` merges multi-host shards into a
 Perfetto-loadable Chrome trace + straggler/spike/regression analysis;
-``sinks.read_events`` is the tolerant (rotation-aware) read-back both
-build on.
+``tools/tracepath.py`` (over ``traceassembly.py`` + ``tracing.py``)
+reassembles cross-process request traces from per-process shards —
+skew-corrected against the ``fleet_send``/``fleet_recv`` wire markers
+— and attributes each request's end-to-end latency to critical-path
+buckets; ``sinks.read_events`` is the tolerant (rotation-aware)
+read-back all three build on.
 
 Failure-time half (``flight.py`` / ``watchdog.py`` / ``detectors.py`` /
 ``doctor.py``; README "Crash forensics & run health"): an always-on
@@ -246,7 +271,7 @@ fallback / HBM gauges), and the ``doctor`` CLI that classifies a dead
 run from those artifacts.
 """
 
-from pyrecover_tpu.telemetry import flight, metrics, spans, watchdog
+from pyrecover_tpu.telemetry import flight, metrics, spans, tracing, watchdog
 from pyrecover_tpu.telemetry.bus import (
     add_sink,
     close,
@@ -280,6 +305,7 @@ __all__ = [
     "span",
     "record_span",
     "spans",
+    "tracing",
     "metrics",
     "flight",
     "watchdog",

@@ -71,6 +71,10 @@ EVENT_DEPS = {
     "preempt_signal_escalation": (),
     "preempt_stop": ("reason",),
     "slo_alert": ("rule", "kind", "threshold", "state", "value"),
+    "trace_root": ("rid", "trace"),
+    "trace_exemplar": ("rid", "trace", "reason", "e2e_s"),
+    "fleet_send": ("rid", "kind", "trace", "attempt", "mono"),
+    "fleet_recv": ("rid", "kind", "trace", "attempt", "mono"),
 }
 
 # span names whose open-at-death presence changes the verdict
@@ -389,6 +393,30 @@ def analyze(evidence, *, recompile_storm_threshold=DEFAULT_RECOMPILE_STORM):
                     f"time(s), cleared before the stream ended",
                 )
 
+    # cross-process request tracing: when the stream carries trace
+    # context, reassemble it and name the dominant critical-path bucket
+    # of the tail exemplars — the first "why were the slow ones slow"
+    # answer — plus the orphan count (a detached span is an
+    # instrumentation defect, surfaced as a finding)
+    trace_evidence = None
+    from pyrecover_tpu.telemetry import traceassembly
+
+    if traceassembly.has_trace_events(events):
+        trep = traceassembly.assemble_events(events)
+        trace_evidence = {
+            "assembled": trep["traces"]["assembled"],
+            "completed": trep["traces"]["completed"],
+            "orphan_spans": trep["traces"]["orphan_spans"],
+            "dominant_tail_bucket": trep["dominant_tail_bucket"],
+            "exemplars": len(trep["exemplars"]),
+        }
+        if trep["traces"]["orphan_spans"]:
+            finding(
+                "trace_orphans",
+                f"{trep['traces']['orphan_spans']} span(s) detached from "
+                "their request root — a trace-context installation hole",
+            )
+
     # -- classification (most-specific first) --------------------------------
     bundle_reason = (
         (newest_bundle or {}).get("manifest", {}).get("reason", "")
@@ -513,6 +541,7 @@ def analyze(evidence, *, recompile_storm_threshold=DEFAULT_RECOMPILE_STORM):
             "topology_rejections": n_topology,
             "interrupt_history": interrupt_history,
             "slo_alerts": slo_alerts,
+            "tracing": trace_evidence,
             "last_status": (summary or {}).get("status"),
         },
     }
@@ -556,6 +585,19 @@ def render(report, out=None):
         f"{e['n_bundles']} bundle(s), "
         f"last status {e['last_status']}\n"
     )
+    tr = e.get("tracing")
+    if tr:
+        w(
+            f"  tracing: {tr['assembled']} request trace(s) "
+            f"({tr['completed']} completed), {tr['orphan_spans']} orphan "
+            f"span(s)"
+        )
+        if tr.get("dominant_tail_bucket"):
+            w(
+                f"; tail exemplars dominated by "
+                f"{tr['dominant_tail_bucket']}"
+            )
+        w("\n")
     for f in report["findings"]:
         w(f"  - {f['kind']}: {f['detail']}\n")
 

@@ -35,12 +35,21 @@ never adds file I/O between dispatches.
 ``metric="hist_name"`` on any span additionally folds the duration into
 the named :mod:`pyrecover_tpu.telemetry.metrics` histogram — one call
 site wires both the trace slice and the percentile accounting.
+
+Distributed traces: when a :mod:`pyrecover_tpu.telemetry.tracing`
+context is installed on the emitting thread (``with
+tracing.installed(ctx):``), every span — including retroactive
+``record_span`` ones, which the serving engine buffers and emits from
+its pump thread — carries ``trace``/``attempt`` fields and, when it has
+no local parent, parents itself under the wire-propagated attempt span.
+That is what lets ``traceassembly`` re-root a replica's per-request
+spans under the router's root span instead of orphaning them.
 """
 
 import threading
 import time
 
-from pyrecover_tpu.telemetry import bus
+from pyrecover_tpu.telemetry import bus, tracing
 
 _local = threading.local()
 _id_lock = threading.Lock()
@@ -82,6 +91,12 @@ class Span:
         self.span_id = _new_id()
         stack = _stack()
         self.parent_id = stack[-1] if stack else None
+        ctx = tracing.current()
+        if ctx is not None:
+            if self.parent_id is None:
+                self.parent_id = ctx.span
+            fields.setdefault("trace", ctx.trace)
+            fields.setdefault("attempt", ctx.attempt)
         stack.append(self.span_id)
         self._open = True
         self.t0 = time.monotonic()
@@ -258,10 +273,16 @@ class collective_phase:
 
 # jaxlint: host-only
 def record_span(name, begin_mono, end_mono, *, parent=None, metric=None,
-                **fields):
+                span_id=None, **fields):
     """Record an already-elapsed span from two ``time.monotonic()`` stamps
     (one ``span`` event, no begin/end pair). The hot-loop path: timestamps
     are captured per step, the event is written at the next sync point.
+
+    Carries the thread's installed trace context (``trace``/``attempt``
+    fields; the wire attempt span as parent when there is no local one),
+    so buffered per-request spans join their distributed trace instead of
+    orphaning. ``span_id`` overrides the process-local integer id with a
+    trace-scoped one (the router's root/attempt spans).
     Returns the span id (or None without sinks)."""
     dur = max(end_mono - begin_mono, 0.0)
     if metric is not None:
@@ -270,9 +291,16 @@ def record_span(name, begin_mono, end_mono, *, parent=None, metric=None,
         metrics.histogram(metric).observe(dur)
     if not bus.enabled():
         return None
-    span_id = _new_id()
+    if span_id is None:
+        span_id = _new_id()
+    ctx = tracing.current()
+    if ctx is not None:
+        fields.setdefault("trace", ctx.trace)
+        fields.setdefault("attempt", ctx.attempt)
     if parent is None:
         parent = current_span_id()
+    if parent is None and ctx is not None:
+        parent = ctx.span
     bus.emit(
         "span", name=name, span=span_id, parent=parent,
         tid=threading.get_ident(), mono=round(begin_mono, 6),

@@ -1,0 +1,135 @@
+"""Per-request distributed trace context for the serving fleet.
+
+A request that crosses the fleet wire (router process -> replica
+subprocess -> back) leaves spans in several per-process telemetry
+shards. This module is the identity + propagation layer that lets
+``telemetry/traceassembly.py`` stitch those shards back into ONE rooted
+causal tree per request:
+
+* **trace id** — deterministic from the content-derived request id
+  (``loadgen.request_id``): ``trace_id(rid)`` is a 16-hex blake2b
+  digest, so the router, every replica attempt, and offline assembly
+  all derive the same id with no coordination.
+* **root / attempt span ids** — cross-process span ids extend the
+  process-local integer scheme in :mod:`pyrecover_tpu.telemetry.spans`
+  with *trace-scoped string ids*: ``<trace>:r`` for the request's root
+  span (owned by the router) and ``<trace>:a<N>`` for dispatch attempt
+  ``N`` (N restarts from the root on every redrive — both attempts of a
+  redriven request hang under one root).
+* **thread-local installation** — ``with installed(ctx):`` makes every
+  span opened on that thread (``span()`` / ``begin()`` / retroactive
+  ``record_span``) carry ``trace``/``attempt`` fields and parent itself
+  under the wire-propagated attempt span when it has no local parent.
+  ``installed(None)`` is a no-op context, so request paths can install
+  unconditionally (the obscheck ``untraced-request-span`` rule keys on
+  exactly this installation being present).
+* **wire codec** — ``ctx.to_wire()`` / ``from_wire(d)`` move the
+  context across the fleet NDJSON protocol as a plain dict; unknown or
+  absent ``trace`` frames decode to None, so old peers interoperate.
+
+The module deliberately emits nothing itself: minting and installation
+are free of I/O; the protocol-level markers (``trace_root``,
+``fleet_send``, ``fleet_recv``, ``trace_exemplar``) are emitted by the
+router/replica at well-defined wire edges, where they double as the
+clock-alignment anchors trace assembly uses for genuinely different
+process clocks.
+"""
+
+import threading
+from hashlib import blake2b
+
+_local = threading.local()
+
+
+def trace_id(rid, epoch=""):  # jaxlint: host-only
+    """Deterministic 16-hex trace id from the content-derived request
+    id — every process (and offline assembly) derives the same id. The
+    optional ``epoch`` qualifier (a deployment/phase label, still fully
+    deterministic) keeps deliberate same-workload replays — the chaos
+    drill's baseline vs kill phases — from colliding in a merged
+    stream."""
+    key = str(rid) if not epoch else f"{epoch}\x00{rid}"
+    return blake2b(key.encode(), digest_size=8).hexdigest()
+
+
+def root_span_id(tid):  # jaxlint: host-only
+    """The trace's root span id (owned by the router)."""
+    return f"{tid}:r"
+
+
+def attempt_span_id(tid, attempt):  # jaxlint: host-only
+    """The span id of dispatch attempt ``attempt`` (1-based; a redrive
+    re-dispatches the SAME trace as attempt N+1 under the same root)."""
+    return f"{tid}:a{int(attempt)}"
+
+
+class TraceContext:
+    """Immutable-by-convention (trace, parent span, attempt) triple."""
+
+    __slots__ = ("trace", "span", "attempt")
+
+    def __init__(self, trace, span, attempt=1):  # jaxlint: host-only
+        self.trace = str(trace)
+        self.span = str(span)
+        self.attempt = int(attempt)
+
+    def child(self, span):  # jaxlint: host-only
+        """Same trace/attempt, reparented under ``span``."""
+        return TraceContext(self.trace, span, self.attempt)
+
+    def to_wire(self):  # jaxlint: host-only
+        return {"trace": self.trace, "span": self.span,
+                "attempt": self.attempt}
+
+    def __repr__(self):  # jaxlint: host-only
+        return (f"TraceContext(trace={self.trace!r}, span={self.span!r}, "
+                f"attempt={self.attempt})")
+
+
+def mint(rid, epoch=""):  # jaxlint: host-only
+    """Root context for a newly admitted request: parent = root span."""
+    tid = trace_id(rid, epoch)
+    return TraceContext(tid, root_span_id(tid), attempt=1)
+
+
+def from_wire(d):  # jaxlint: host-only
+    """Decode a protocol ``trace`` dict; None (or garbage) -> None, so
+    frames from peers that predate tracing still dispatch."""
+    if not isinstance(d, dict):
+        return None
+    trace, span = d.get("trace"), d.get("span")
+    if not trace or not span:
+        return None
+    try:
+        attempt = int(d.get("attempt", 1))
+    except (TypeError, ValueError):
+        attempt = 1
+    return TraceContext(trace, span, attempt)
+
+
+def current():  # jaxlint: host-only
+    """The context installed on THIS thread, or None."""
+    return getattr(_local, "ctx", None)
+
+
+class installed:
+    """Install ``ctx`` thread-locally for the body (None = no-op, so
+    request-handling paths install unconditionally). Re-entrant: the
+    prior context is restored on exit."""
+
+    __slots__ = ("ctx", "_prev")
+
+    def __init__(self, ctx):  # jaxlint: host-only
+        self.ctx = ctx
+        self._prev = None
+
+    def __enter__(self):  # jaxlint: host-only
+        self._prev = getattr(_local, "ctx", None)
+        if self.ctx is not None:
+            _local.ctx = self.ctx
+        return self.ctx
+
+    def __exit__(self, exc_type, exc, tb):  # jaxlint: host-only
+        if self.ctx is not None:
+            _local.ctx = self._prev
+        return False

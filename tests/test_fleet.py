@@ -335,10 +335,16 @@ def test_fleet_events_are_cataloged():
 
     assert_observed(
         events=("replica_spawned", "replica_dead", "replica_quarantined",
-                "request_redriven", "fleet_shed", "canary_verdict"),
+                "request_redriven", "fleet_shed", "canary_verdict",
+                "trace_root", "trace_exemplar", "fleet_send", "fleet_recv"),
+        spans=("req_root", "fleet_attempt", "swap_stall"),
     )
     readme = (REPO / "README.md").read_text()
     assert "## Serving fleet" in readme
     # cross-links the satellite demands
     assert "#serving-fleet" in readme
     assert "--fleet-smoke" in readme
+    # the distributed-tracing section, cross-linked from the fleet,
+    # hot-swap, and traceview prose
+    assert "## Distributed request tracing" in readme
+    assert readme.count("#distributed-request-tracing") >= 3

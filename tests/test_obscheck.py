@@ -250,6 +250,25 @@ def consume(hists):
 ''',
         README_ALPHA,
     ),
+    # LOCAL rule (no catalog sentinel needed): a per-request span
+    # (rid= kwarg) with neither trace= nor an enclosing installed(...)
+    "untraced-request-span": (
+        '''from pyrecover_tpu import telemetry
+
+
+def finish(rid, t0, t1):
+    telemetry.record_span("req_queue", t0, t1, rid=rid)
+''',
+        '''from pyrecover_tpu import telemetry
+from pyrecover_tpu.telemetry import tracing
+
+
+def finish(rid, t0, t1, ctx):
+    with tracing.installed(ctx):
+        telemetry.record_span("req_queue", t0, t1, rid=rid)
+''',
+        "",
+    ),
 }
 
 # catalog-divergence is the one rule whose hazard lives in the README
@@ -288,7 +307,7 @@ def test_rule_quiet_on_clean_snippet(rule_name):
 # rules whose finding anchors on a CODE line (a tokenize comment can sit
 # there); the docstring/README-anchored rules are suppressed file-wide
 _INLINE = ("unknown-event", "consumer-field-drift", "hot-path-emit",
-           "metric-name-drift")
+           "metric-name-drift", "untraced-request-span")
 
 
 @pytest.mark.parametrize("rule_name", _INLINE)
@@ -342,7 +361,7 @@ def test_every_catalog_rule_has_a_fixture():
 def test_catalog_ids_unique_and_documented():
     ids = [r.id for r in OB_RULES.values()]
     assert len(set(ids)) == len(ids)
-    assert set(ids) == {f"OB{i:02d}" for i in range(1, 7)}
+    assert set(ids) == {f"OB{i:02d}" for i in range(1, 8)}
     readme = (REPO / "README.md").read_text(encoding="utf-8")
     for r in OB_RULES.values():
         assert r.id in readme and r.name in readme, (

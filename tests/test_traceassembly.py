@@ -1,0 +1,469 @@
+"""Cross-process trace assembly: deterministic identity + wire codec
+(``telemetry/tracing.py``), skew-corrected tree assembly and
+critical-path attribution (``telemetry/traceassembly.py``) under
+ADVERSARIAL clocks — replica monotonic epochs thousands of seconds off
+the router's and wall clocks that step backwards mid-run — plus the
+regression pin for retroactive ``record_span`` children joining their
+installed trace, orphan accounting, shed synthetic roots, tail-based
+exemplar retention, and the ``tools/tracepath.py`` CLI contract."""
+
+import json
+
+import pytest
+
+from pyrecover_tpu import telemetry
+from pyrecover_tpu.telemetry import traceassembly, tracing
+
+# ---------------------------------------------------------------------------
+# identity + wire codec
+# ---------------------------------------------------------------------------
+
+
+def test_trace_id_deterministic_and_epoch_qualified():
+    a, b = tracing.trace_id("rid-1"), tracing.trace_id("rid-1")
+    assert a == b and len(a) == 16 and int(a, 16) >= 0
+    assert tracing.trace_id("rid-2") != a
+    # the epoch qualifier keeps deliberate same-workload replays apart
+    assert tracing.trace_id("rid-1", epoch="b") != a
+    assert tracing.trace_id("rid-1", epoch="b") == tracing.trace_id(
+        "rid-1", epoch="b")
+
+
+def test_root_and_attempt_span_ids_extend_the_trace():
+    ctx = tracing.mint("rid-1")
+    assert ctx.span == f"{ctx.trace}:r" == tracing.root_span_id(ctx.trace)
+    assert tracing.attempt_span_id(ctx.trace, 2) == f"{ctx.trace}:a2"
+    child = ctx.child(tracing.attempt_span_id(ctx.trace, 2))
+    assert (child.trace, child.attempt) == (ctx.trace, ctx.attempt)
+    assert child.span == f"{ctx.trace}:a2"
+
+
+def test_wire_codec_roundtrip_and_garbage_tolerance():
+    ctx = tracing.TraceContext("t" * 16, "t" * 16 + ":a2", attempt=2)
+    back = tracing.from_wire(ctx.to_wire())
+    assert (back.trace, back.span, back.attempt) == (
+        ctx.trace, ctx.span, ctx.attempt)
+    # peers that predate tracing (or corrupt frames) decode to None
+    assert tracing.from_wire(None) is None
+    assert tracing.from_wire("nope") is None
+    assert tracing.from_wire({}) is None
+    assert tracing.from_wire({"trace": "x"}) is None
+    bad_attempt = tracing.from_wire(
+        {"trace": "x", "span": "x:r", "attempt": "??"})
+    assert bad_attempt.attempt == 1
+
+
+def test_installed_is_reentrant_and_none_is_noop():
+    assert tracing.current() is None
+    ctx1, ctx2 = tracing.mint("a"), tracing.mint("b")
+    with tracing.installed(ctx1):
+        assert tracing.current() is ctx1
+        with tracing.installed(None):
+            assert tracing.current() is ctx1  # None installs nothing
+        with tracing.installed(ctx2):
+            assert tracing.current() is ctx2
+        assert tracing.current() is ctx1
+    assert tracing.current() is None
+
+
+# ---------------------------------------------------------------------------
+# record_span carries the installed context (the satellite regression)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def mem_sink():
+    mem = telemetry.MemorySink()
+    telemetry.add_sink(mem)
+    yield mem
+    telemetry.remove_sink(mem)
+
+
+def test_record_span_carries_installed_trace_context(mem_sink):
+    """A buffered (retroactive) span recorded under an installed wire
+    context must carry trace/attempt and parent itself under the wire
+    attempt span — the exact bug class OB07 guards statically."""
+    ctx = tracing.mint("rid-9").child(
+        tracing.attempt_span_id(tracing.trace_id("rid-9"), 1))
+    with tracing.installed(ctx):
+        telemetry.record_span("req_queue", 10.0, 10.5, rid="rid-9")
+    (e,) = [e for e in mem_sink.events if e["event"] == "span"]
+    assert e["trace"] == ctx.trace
+    assert e["attempt"] == 1
+    assert e["parent"] == ctx.span
+
+
+def test_retroactive_child_assembles_under_the_wire_attempt(mem_sink):
+    """End-to-end regression: root event + retroactive router spans +
+    a record_span child emitted under the installed context must
+    assemble into ONE rooted tree with zero orphans."""
+    tid = tracing.trace_id("rid-9")
+    telemetry.emit("trace_root", rid="rid-9", trace=tid,
+                   span=tracing.root_span_id(tid), verdict="accepted",
+                   mono=10.0)
+    telemetry.record_span(
+        "fleet_attempt", 10.0, 10.6,
+        span_id=tracing.attempt_span_id(tid, 1),
+        parent=tracing.root_span_id(tid), trace=tid, attempt=1,
+        rid="rid-9")
+    telemetry.record_span(
+        "req_root", 10.0, 10.6, span_id=tracing.root_span_id(tid),
+        trace=tid, rid="rid-9", attempts=1, redrives=0)
+    with tracing.installed(
+            tracing.mint("rid-9").child(tracing.attempt_span_id(tid, 1))):
+        telemetry.record_span("req_decode", 10.1, 10.5, rid="rid-9")
+    report = traceassembly.assemble_events(list(mem_sink.events))
+    assert report["traces"]["assembled"] == 1
+    assert report["traces"]["orphan_spans"] == 0
+    entry = report["per_trace"][tid]
+    assert entry["rooted"] and entry["spans"] == 3
+    assert entry["buckets"]["decode"] == pytest.approx(0.4)
+
+
+# ---------------------------------------------------------------------------
+# adversarial-clock assembly: the full redrive scenario
+# ---------------------------------------------------------------------------
+
+WALL = 1.7e9  # arbitrary wall epoch for the router
+
+
+def _adversarial_domains():
+    """One redriven request (killed replica A -> replica B) plus one
+    clean request, across three clock domains:
+
+    * replica A's monotonic clock sits 5000 s BEHIND the router's and
+      the kill leaves only one-way (submit) markers;
+    * replica B's sits 9000 s behind, with wire latency 2 ms per leg;
+    * replica A's WALL clock steps 50 s backwards mid-run (NTP step) —
+      marker alignment runs on monotonic stamps and must not care.
+
+    Returns (domains, tid1, tid2) with true offsets +5000 / +9000.
+    """
+    t1 = tracing.trace_id("r1")
+    t2 = tracing.trace_id("r2")
+
+    def ev(event, mono, **f):
+        return {"event": event, "ts": WALL + mono, "mono": mono, **f}
+
+    parent = [
+        # --- r1: admitted, dispatched to A, A killed, redriven to B
+        ev("trace_root", 100.0, rid="r1", trace=t1, span=f"{t1}:r",
+           verdict="accepted"),
+        ev("fleet_send", 100.010, rid="r1", kind="submit", attempt=1,
+           trace=t1),
+        # kill noticed at 100.5: failed attempt span + re-dispatch
+        ev("span", 100.010, name="fleet_attempt", span=f"{t1}:a1",
+           parent=f"{t1}:r", trace=t1, attempt=1, rid="r1", dur_s=0.49,
+           ok=False, redriven=True),
+        ev("fleet_send", 100.510, rid="r1", kind="submit", attempt=2,
+           trace=t1),
+        ev("fleet_recv", 101.5, rid="r1", kind="done", attempt=2,
+           trace=t1),
+        ev("span", 100.510, name="fleet_attempt", span=f"{t1}:a2",
+           parent=f"{t1}:r", trace=t1, attempt=2, rid="r1", dur_s=0.99),
+        ev("span", 100.0, name="req_root", span=f"{t1}:r", parent=None,
+           trace=t1, attempt=2, rid="r1", dur_s=1.5, attempts=2,
+           redrives=1),
+        # --- r2: clean single-attempt request on B
+        ev("trace_root", 102.0, rid="r2", trace=t2, span=f"{t2}:r",
+           verdict="accepted"),
+        ev("fleet_send", 102.010, rid="r2", kind="submit", attempt=1,
+           trace=t2),
+        ev("fleet_recv", 102.2, rid="r2", kind="done", attempt=1,
+           trace=t2),
+        ev("span", 102.010, name="fleet_attempt", span=f"{t2}:a1",
+           parent=f"{t2}:r", trace=t2, attempt=1, rid="r2", dur_s=0.19),
+        ev("span", 102.0, name="req_root", span=f"{t2}:r", parent=None,
+           trace=t2, attempt=1, rid="r2", dur_s=0.21, attempts=1,
+           redrives=0),
+        ev("trace_exemplar", 103.0, rid="r1", trace=t1,
+           reason="redriven", e2e_s=1.5),
+    ]
+
+    def eva(event, mono, **f):
+        # replica A: mono 5000 s behind; wall clock STEPS -50 s mid-run
+        step = -50.0 if mono > -4899.6 else 0.0
+        return {"event": event, "ts": WALL + 300.0 + mono + step,
+                "mono": mono, **f}
+
+    rep_a = [
+        # arrival 2 ms after the router's send: -4899.988 = 100.012-5000
+        eva("fleet_recv", -4899.988, rid="r1", kind="submit", attempt=1,
+            trace=t1),
+        # the engine opened req_queue and was SIGKILLed mid-span: an
+        # unpaired begin truncates at the domain's last mono stamp
+        eva("span_begin", -4899.985, name="req_queue", span=1,
+            parent=f"{t1}:a1", trace=t1, attempt=1, rid="r1"),
+        eva("heartbeat", -4899.5),
+    ]
+
+    def evb(event, mono, **f):
+        return {"event": event, "ts": WALL + 7.0 + mono, "mono": mono, **f}
+
+    rep_b = [
+        # r1 attempt 2: arrival 100.512-9000, done send 101.498-9000
+        evb("fleet_recv", -8899.488, rid="r1", kind="submit", attempt=2,
+            trace=t1),
+        evb("span", -8899.488, name="req_queue", span=1,
+            parent=f"{t1}:a2", trace=t1, attempt=2, rid="r1", dur_s=0.1),
+        evb("span", -8899.388, name="req_prefill", span=2,
+            parent=f"{t1}:a2", trace=t1, attempt=2, rid="r1", dur_s=0.2),
+        evb("span", -8899.188, name="req_decode", span=3,
+            parent=f"{t1}:a2", trace=t1, attempt=2, rid="r1", dur_s=0.6),
+        # a hot-swap flip stalled 150 ms of that decode window
+        evb("span", -8899.0, name="swap_stall", span=4,
+            parent=f"{t1}:a2", trace=t1, attempt=2, rid="r1",
+            dur_s=0.15),
+        evb("fleet_send", -8898.502, rid="r1", kind="done", attempt=2,
+            trace=t1),
+        # r2: arrival 102.012-9000, done send 102.198-9000
+        evb("fleet_recv", -8897.988, rid="r2", kind="submit", attempt=1,
+            trace=t2),
+        evb("span", -8897.988, name="req_queue", span=5,
+            parent=f"{t2}:a1", trace=t2, attempt=1, rid="r2",
+            dur_s=0.01),
+        evb("span", -8897.978, name="req_prefill", span=6,
+            parent=f"{t2}:a1", trace=t2, attempt=1, rid="r2",
+            dur_s=0.05),
+        evb("span", -8897.928, name="req_decode", span=7,
+            parent=f"{t2}:a1", trace=t2, attempt=1, rid="r2", dur_s=0.1),
+        evb("fleet_send", -8897.802, rid="r2", kind="done", attempt=1,
+            trace=t2),
+    ]
+    domains = [
+        traceassembly.Domain("router", parent),
+        traceassembly.Domain("replica_a", rep_a),
+        traceassembly.Domain("replica_b", rep_b),
+    ]
+    return domains, t1, t2
+
+
+def test_adversarial_clocks_offsets_recovered():
+    domains, _, _ = _adversarial_domains()
+    report = traceassembly.assemble(domains)
+    by_label = {d["label"]: d for d in report["domains"]}
+    assert by_label["router"]["parent"]
+    # B has both legs: the symmetric estimate cancels the 2 ms wire
+    # latency exactly (mean of offset−wire and offset+wire)
+    assert by_label["replica_b"]["offset_source"] == "markers"
+    assert by_label["replica_b"]["clock_offset_s"] == pytest.approx(
+        9000.0, abs=1e-4)
+    # A was killed: only the submit leg survives, so the one-way
+    # estimate is biased by at most one wire latency
+    assert by_label["replica_a"]["offset_source"] == "markers-oneway"
+    assert by_label["replica_a"]["clock_offset_s"] == pytest.approx(
+        5000.0, abs=0.01)
+
+
+def test_adversarial_clocks_trees_and_attribution():
+    domains, t1, t2 = _adversarial_domains()
+    report = traceassembly.assemble(domains)
+    assert report["traces"]["assembled"] == 2
+    assert report["traces"]["completed"] == 2
+    assert report["traces"]["orphan_spans"] == 0
+
+    e1 = report["per_trace"][t1]
+    assert e1["attempts"] == 2 and e1["redrives"] == 1
+    assert e1["complete"] and e1["residual_ok"]
+    b = e1["buckets"]
+    assert b["route"] == pytest.approx(0.010, abs=1e-6)
+    # the whole kill->redispatch hole, on the router's own clock: exact
+    assert b["redrive_gap"] == pytest.approx(0.5, abs=1e-6)
+    # two skew-corrected 2 ms legs of the FINAL attempt
+    assert b["wire"] == pytest.approx(0.004, abs=1e-3)
+    assert b["queue"] == pytest.approx(0.1, abs=1e-6)
+    assert b["prefill"] == pytest.approx(0.2, abs=1e-6)
+    # the stall is carved OUT of decode: attributed once, not twice
+    assert b["decode"] == pytest.approx(0.45, abs=1e-6)
+    assert b["swap_stall"] == pytest.approx(0.15, abs=1e-6)
+    assert abs(b["residual"]) <= e1["residual_tolerance_s"]
+    assert e1["dominant"] == "redrive_gap"
+
+    e2 = report["per_trace"][t2]
+    assert e2["attempts"] == 1 and e2["complete"] and e2["residual_ok"]
+    assert report["residual_violations"] == []
+
+    # ordering survives the clock chaos: replica-B spans of attempt 2
+    # land between the router's dispatch and completion stamps
+    tree = report["exemplars"][t1]["tree"]
+    t0s = {n["name"]: n["t0"] for n in tree if n["attempt"] == 2}
+    assert 100.510 < t0s["req_queue"] < t0s["req_prefill"] \
+        < t0s["req_decode"] < 101.5
+
+
+def test_adversarial_clocks_exemplars_and_truncation():
+    domains, t1, t2 = _adversarial_domains()
+    report = traceassembly.assemble(domains)
+    # the router's mark wins: full tree only for the redriven request
+    assert set(report["exemplars"]) == {t1}
+    assert report["exemplars"][t1]["reason"] == "redriven"
+    assert report["dominant_tail_bucket"] == "redrive_gap"
+    # the killed attempt's unpaired span_begin closed as truncated and
+    # still attached under the failed attempt span — not an orphan
+    tree = report["exemplars"][t1]["tree"]
+    names = [n["name"] for n in tree]
+    assert names.count("req_queue") == 2  # truncated A + real B
+    assert any(not n["ok"] for n in tree if n["name"] == "fleet_attempt")
+
+
+def test_wall_clock_step_does_not_shear_marker_alignment():
+    """Stepping replica A's wall clock by -50 s (already baked into the
+    fixture) vs not stepping it must produce identical offsets: the
+    marker path never reads ``ts``."""
+    stepped, _, _ = _adversarial_domains()
+    flat, _, _ = _adversarial_domains()
+    for e in flat[1].events:
+        e["ts"] = WALL + 300.0 + e["mono"]  # undo the step
+    r1 = traceassembly.assemble(stepped)
+    r2 = traceassembly.assemble(flat)
+    assert [d["clock_offset_s"] for d in r1["domains"]] == \
+        [d["clock_offset_s"] for d in r2["domains"]]
+
+
+def test_wall_anchor_fallback_for_marker_free_domain():
+    """A domain with no wire markers (a training-style shard) aligns
+    through traceview's shared wall anchors, mapped onto the mono
+    timeline via each domain's wall epoch."""
+    tid = tracing.trace_id("rx")
+    parent = [
+        {"event": "trace_root", "ts": WALL + 10.0, "mono": 10.0,
+         "rid": "rx", "trace": tid, "span": f"{tid}:r",
+         "verdict": "accepted"},
+        {"event": "span", "ts": WALL + 10.0, "mono": 10.0,
+         "name": "req_root", "span": f"{tid}:r", "parent": None,
+         "trace": tid, "rid": "rx", "dur_s": 1.0, "attempts": 1},
+        {"event": "step_time", "ts": WALL + 11.0, "mono": 11.0,
+         "step": 7},
+    ]
+    # child mono epoch 2000 s behind; wall clock 3 s ahead of parent's
+    child = [
+        {"event": "step_time", "ts": WALL + 14.0, "mono": -1989.0,
+         "step": 7},
+        {"event": "span", "ts": WALL + 13.2, "mono": -1989.8,
+         "name": "req_decode", "span": 1, "parent": f"{tid}:r",
+         "trace": tid, "attempt": 1, "rid": "rx", "dur_s": 0.5},
+    ]
+    domains = [traceassembly.Domain("parent", parent),
+               traceassembly.Domain("child", child)]
+    report = traceassembly.assemble(domains)
+    d = {x["label"]: x for x in report["domains"]}
+    assert d["child"]["offset_source"] == "wall-anchors"
+    # the anchors mark the same logical moment: true mono offset is
+    # parent 11.0 vs child -1989.0 = 2000 s — the anchor deltas cancel
+    # the 3 s wall-clock skew that the raw epoch difference includes
+    assert d["child"]["clock_offset_s"] == pytest.approx(2000.0, abs=1e-6)
+    assert report["traces"]["orphan_spans"] == 0
+
+
+# ---------------------------------------------------------------------------
+# domains, orphans, shed roots
+# ---------------------------------------------------------------------------
+
+
+def test_split_events_by_replica_tag():
+    events = [
+        {"event": "trace_root", "mono": 1.0},
+        {"event": "fleet_recv", "mono": 2.0, "replica": 0},
+        {"event": "fleet_send", "mono": 3.0, "replica": 1},
+        {"event": "replica_dead", "mono": 4.0},
+    ]
+    domains = traceassembly.split_events(events, label="merged")
+    labels = {d.label: len(d.events) for d in domains}
+    assert labels == {"merged": 2, "merged[r0]": 1, "merged[r1]": 1}
+
+
+def test_orphan_spans_are_counted_and_named():
+    tid = tracing.trace_id("rz")
+    events = [
+        {"event": "trace_root", "mono": 1.0, "rid": "rz", "trace": tid,
+         "span": f"{tid}:r", "verdict": "accepted"},
+        {"event": "span", "mono": 1.0, "name": "req_root",
+         "span": f"{tid}:r", "parent": None, "trace": tid, "rid": "rz",
+         "dur_s": 1.0, "attempts": 1},
+        # parent id that exists in no domain: unattachable by construction
+        {"event": "span", "mono": 1.2, "name": "req_decode", "span": 9,
+         "parent": "nonexistent:a7", "trace": tid, "attempt": 1,
+         "rid": "rz", "dur_s": 0.3},
+    ]
+    report = traceassembly.assemble_events(events)
+    assert report["traces"]["orphan_spans"] == 1
+    (o,) = report["orphans"]
+    assert o["name"] == "req_decode" and o["trace"] == tid
+    # the orphaned span contributes NOTHING to attribution
+    assert report["per_trace"][tid]["buckets"]["decode"] == 0.0
+
+
+def test_shed_request_roots_synthetically():
+    tid = tracing.trace_id("shed-1")
+    events = [{"event": "trace_root", "mono": 5.0, "rid": "shed-1",
+               "trace": tid, "span": f"{tid}:r", "verdict": "shed"}]
+    report = traceassembly.assemble_events(events)
+    entry = report["per_trace"][tid]
+    assert entry["rooted"] and entry["verdict"] == "shed"
+    assert report["traces"]["root_only"] == 1
+    assert report["traces"]["completed"] == 0
+
+
+def test_p99_fallback_when_router_never_marked():
+    """A run that never drained has no trace_exemplar marks; the p99
+    tail is recomputed so SOME full trees are still retained."""
+    domains, t1, _ = _adversarial_domains()
+    for d in domains:
+        d.events = [e for e in d.events
+                    if e.get("event") != "trace_exemplar"]
+    report = traceassembly.assemble(domains)
+    assert report["exemplars"], "p99 fallback retained nothing"
+    assert all(i["reason"] == "p99_tail"
+               for i in report["exemplars"].values())
+    assert t1 in report["exemplars"]  # the 1.5 s redrive IS the tail
+
+
+# ---------------------------------------------------------------------------
+# CLI contract (tools/tracepath.py shim over traceassembly.main)
+# ---------------------------------------------------------------------------
+
+
+def _write_shards(tmp_path):
+    domains, _, _ = _adversarial_domains()
+    paths = []
+    for d in domains:
+        p = tmp_path / f"{d.label}.jsonl"
+        p.write_text("".join(json.dumps(e) + "\n" for e in d.events))
+        paths.append(str(p))
+    return paths
+
+
+def test_cli_assembles_and_gates(tmp_path, capsys):
+    paths = _write_shards(tmp_path)
+    out_json = tmp_path / "report.json"
+    rc = traceassembly.main(
+        paths + ["--json", str(out_json), "--expect-complete"])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "2 trace(s) assembled" in text
+    assert "critical-path attribution" in text
+    assert "redrive_gap" in text
+    report = json.loads(out_json.read_text())
+    assert report["traces"]["orphan_spans"] == 0
+
+
+def test_cli_exit_2_without_trace_events(tmp_path):
+    p = tmp_path / "plain.jsonl"
+    p.write_text(json.dumps({"event": "step_time", "step": 1,
+                             "mono": 1.0, "ts": WALL}) + "\n")
+    assert traceassembly.main([str(p)]) == 2
+
+
+def test_cli_exit_1_on_orphans(tmp_path, capsys):
+    tid = tracing.trace_id("rz")
+    p = tmp_path / "orphan.jsonl"
+    rows = [
+        {"event": "trace_root", "mono": 1.0, "rid": "rz", "trace": tid,
+         "span": f"{tid}:r", "verdict": "accepted"},
+        {"event": "span", "mono": 1.2, "name": "req_decode", "span": 9,
+         "parent": "lost:a1", "trace": tid, "attempt": 1, "rid": "rz",
+         "dur_s": 0.3},
+    ]
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    assert traceassembly.main([str(p), "--expect-complete"]) == 1
+    assert "ORPHAN" in capsys.readouterr().out

@@ -35,6 +35,7 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 from pyrecover_tpu.telemetry import read_events  # noqa: E402
+from pyrecover_tpu.telemetry import traceassembly  # noqa: E402
 
 
 def _fmt_s(x):
@@ -438,6 +439,29 @@ def aggregate(events):
             ],
         }
     agg["fleet"] = fleet
+
+    # cross-process request tracing: reassemble the merged stream into
+    # rooted per-request trees (the `replica` tag splits it back into
+    # clock domains) and roll up the critical-path attribution — the
+    # README "Distributed request tracing" contract
+    tracing_agg = {}
+    if traceassembly.has_trace_events(events):
+        rep = traceassembly.assemble_events(events)
+        reasons = defaultdict(int)
+        for info in rep["exemplars"].values():
+            reasons[info["reason"]] += 1
+        tracing_agg = {
+            "domains": len(rep["domains"]),
+            "assembled": rep["traces"]["assembled"],
+            "completed": rep["traces"]["completed"],
+            "root_only": rep["traces"]["root_only"],
+            "orphan_spans": rep["traces"]["orphan_spans"],
+            "buckets": rep["buckets"],
+            "dominant_tail_bucket": rep["dominant_tail_bucket"],
+            "exemplars": dict(reasons),
+            "residual_violations": len(rep["residual_violations"]),
+        }
+    agg["tracing"] = tracing_agg
 
     # checkpoint-policy (autopilot) rollup + the static-policy
     # counterfactual: replay the SAME event stream against the configured
@@ -869,6 +893,30 @@ def render(agg, out=None):
             tail = f" ({v['reason']})" if v.get("reason") else ""
             w(f"  canary             {v['verdict'].upper()}{tail} — "
               f"{v.get('manifest')}, waved {v.get('waved')}\n")
+    tr = agg.get("tracing") or {}
+    if tr:
+        w("\n-- request tracing (cross-process) -----------------------------\n")
+        w(f"  traces             {tr['assembled']} assembled over "
+          f"{tr['domains']} clock domain(s) — {tr['completed']} completed, "
+          f"{tr['root_only']} root-only, {tr['orphan_spans']} orphan "
+          f"span(s)\n")
+        for bucket in traceassembly.BUCKETS:
+            st = (tr.get("buckets") or {}).get(bucket)
+            if st is None:
+                continue
+            w(f"    {bucket:<12} p50 {st['p50_s'] * 1e3:9.2f}ms  "
+              f"p99 {st['p99_s'] * 1e3:9.2f}ms\n")
+        if tr.get("exemplars"):
+            kinds = ", ".join(
+                f"{n} {r}" for r, n in sorted(tr["exemplars"].items()))
+            w(f"  tail exemplars     {sum(tr['exemplars'].values())} "
+              f"full tree(s) retained ({kinds})")
+            if tr.get("dominant_tail_bucket"):
+                w(f" — dominated by {tr['dominant_tail_bucket']}")
+            w("\n")
+        if tr.get("residual_violations"):
+            w(f"  RESIDUAL           {tr['residual_violations']} trace(s) "
+              f"outside the named tolerance\n")
     al = agg.get("alerts") or {}
     if al.get("events"):
         w("\n-- SLO alerts (exporter burn-rate rules) -----------------------\n")

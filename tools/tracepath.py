@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""tracepath CLI — reassemble cross-process request traces from the
+fleet's per-process telemetry shards: skew-corrected per-request trees,
+critical-path attribution (queue/route/wire/prefill/decode/swap-stall/
+redrive-gap, residual named), orphan-span accounting, tail exemplars.
+
+Usage:
+    python tools/tracepath.py parent.jsonl replica_0.jsonl replica_1.jsonl
+    python tools/tracepath.py merged.jsonl --json report.json
+    python tools/tracepath.py merged.jsonl --expect-complete   # CI gate
+
+All logic lives in ``pyrecover_tpu.telemetry.traceassembly``; this file
+is the executable shim so the tool is runnable before the package is
+installed.
+"""
+
+import sys
+from pathlib import Path
+
+# runnable from any cwd, installed or not
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from pyrecover_tpu.telemetry.traceassembly import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
